@@ -1,0 +1,33 @@
+"""CUP3D-TPU: a TPU-native incompressible Navier-Stokes framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of slitvinov/CUP3D
+(condensed CubismUP_3D, ``/root/reference/main.cpp``): 3-D incompressible flow
+with pressure projection, block-structured AMR, immersed-boundary
+(Brinkman-penalized) self-propelled fish, and distributed execution over a
+``jax.sharding.Mesh``.
+
+Design stance (not a port):
+
+- Fields are dense batched arrays — ``(nx, ny, nz[, 3])`` on a uniform grid,
+  ``(nblocks, B, B, B[, 3])`` on the AMR block octree — so every per-cell
+  kernel is a fused XLA/Pallas stencil over the batch.
+- The octree, neighbor tables and coarse-fine interpolation selectors are
+  integer index arrays built on host and consumed by jitted gathers.
+- Halo exchange is XLA SPMD partitioning / ``lax.ppermute`` over an ICI mesh,
+  never hand-rolled point-to-point messaging.
+- Host-side sequential/irregular logic (tree state machine, fish midline ODEs,
+  6-DOF dynamics) stays in NumPy/C++ and hands device buffers to jitted code.
+"""
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy to keep `import cup3d_tpu` light and cycle-free
+    if name in ("Simulation", "SimulationData"):
+        try:
+            from cup3d_tpu.sim import data, simulation
+        except ImportError as e:  # PEP 562: missing attrs raise AttributeError
+            raise AttributeError(name) from e
+        return getattr(simulation if name == "Simulation" else data, name)
+    raise AttributeError(name)

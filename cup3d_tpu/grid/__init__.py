@@ -1,0 +1,1 @@
+from cup3d_tpu.grid.uniform import UniformGrid, BC  # noqa: F401

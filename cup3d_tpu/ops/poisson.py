@@ -1,0 +1,114 @@
+"""Pressure Poisson solvers.
+
+The reference solves ``lap p = rhs`` with a pipelined BiCGSTAB + per-block CG
+preconditioner (PoissonSolverAMR, main.cpp:14363-14616).  On a *uniform* TPU
+grid we can do strictly better: the 7-point Laplacian with
+periodic/zero-gradient boundaries is diagonalized exactly by FFTs (periodic
+axes) and DCT-II transforms (Neumann axes).  The DCT is applied as a dense
+cosine-basis matmul — an orthogonal transform whose inverse is its transpose
+— which maps straight onto the MXU and is exact to machine precision, with
+O(N) extra flops per cell that the systolic array absorbs.
+
+Discrete eigenvalues per axis (cell-centered, copy-edge ghosts):
+
+- periodic: 2 cos(2 pi k / N) - 2
+- Neumann:  2 cos(pi k / N) - 2      (DCT-II basis)
+
+The Krylov path for non-diagonalizable operators (AMR octree) lives in
+``cup3d_tpu.ops.krylov``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.grid.uniform import BC, UniformGrid
+
+
+def dct2_matrix(n: int, dtype=np.float64) -> np.ndarray:
+    """Orthonormal DCT-II basis matrix C with X = C @ x, x = C.T @ X."""
+    k = np.arange(n)[:, None]
+    j = np.arange(n)[None, :]
+    c = np.cos(np.pi * k * (2 * j + 1) / (2 * n)) * np.sqrt(2.0 / n)
+    c[0] *= np.sqrt(0.5)
+    return c.astype(dtype)
+
+
+def _axis_eigenvalues(n: int, periodic: bool, operator: str) -> np.ndarray:
+    """Per-axis eigenvalues (times h^2) of the chosen discrete Laplacian.
+
+    operator="compact":    7-point Laplacian  -> 2 cos(theta) - 2
+    operator="consistent": div(grad(.)) built from 2h-centered first
+                           differences        -> -sin(theta)^2
+    The consistent form makes the pressure projection remove the centered
+    divergence *exactly* (up to the periodic Nyquist mode, which centered
+    differencing cannot see).
+    """
+    k = np.arange(n)
+    theta = (2.0 * np.pi * k / n) if periodic else (np.pi * k / n)
+    if operator == "compact":
+        return 2.0 * np.cos(theta) - 2.0
+    if operator == "consistent":
+        return -np.sin(theta) ** 2
+    raise ValueError(operator)
+
+
+def _apply_mat(mat, f, axis):
+    # HIGHEST: default matmul precision is bf16-grade on TPU; the inverse
+    # eigenvalues span ~N^2 orders so the transform must be true f32.
+    out = jnp.tensordot(mat, f, axes=([1], [axis]), precision=jax.lax.Precision.HIGHEST)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def build_spectral_solver(grid: UniformGrid, dtype=jnp.float32,
+                          operator: str = "consistent") -> Callable:
+    """Returns jittable solve(rhs) -> p with mean(p) = 0.
+
+    Wall/freespace faces impose zero-gradient (Neumann) pressure ghosts,
+    identical to the reference's BlockLabNeumann treatment of p.  Use
+    operator="consistent" (default) for pressure projection and "compact"
+    to solve the literal 7-point system.
+    """
+    periodic = [b == BC.periodic for b in grid.bc]
+    h = grid.h
+
+    lams = [
+        _axis_eigenvalues(n, p, operator) for n, p in zip(grid.shape, periodic)
+    ]
+    lam = (
+        lams[0][:, None, None] + lams[1][None, :, None] + lams[2][None, None, :]
+    ) / (h * h)
+    lam_flat = lam.reshape(-1)
+    inv = np.zeros_like(lam_flat)
+    nz = np.abs(lam_flat) > 1e-12 * np.max(np.abs(lam_flat))
+    inv[nz] = 1.0 / lam_flat[nz]
+    inv = jnp.asarray(inv.reshape(lam.shape), dtype=dtype)
+
+    dct_mats = {
+        a: jnp.asarray(dct2_matrix(grid.shape[a]), dtype=dtype)
+    # transform matrices only for Neumann axes; FFT handles periodic axes
+        for a in range(3)
+        if not periodic[a]
+    }
+    fft_axes = tuple(a for a in range(3) if periodic[a])
+
+    def solve(rhs: jnp.ndarray) -> jnp.ndarray:
+        f = rhs.astype(dtype)
+        for a, mat in dct_mats.items():
+            f = _apply_mat(mat, f, a)
+        if fft_axes:
+            f = jnp.fft.fftn(f, axes=fft_axes)
+        f = f * inv
+        if fft_axes:
+            f = jnp.fft.ifftn(f, axes=fft_axes)
+            f = jnp.real(f)
+        for a, mat in dct_mats.items():
+            f = _apply_mat(mat.T, f, a)
+        p = f.astype(rhs.dtype)
+        return p - jnp.mean(p)
+
+    return solve

@@ -1,0 +1,98 @@
+"""Cell-level finite-difference stencils on ghost-padded arrays.
+
+Every function takes an array already padded with ``w`` ghost cells on each
+face of the three leading spatial axes (trailing axes, e.g. the vector
+component, are untouched) and returns interior-shaped results.  Written as
+pure slicing arithmetic so XLA fuses each kernel into one pass over HBM.
+
+Math sources in the reference (not code): 7-point Laplacian and 2nd-order
+centered first derivatives throughout (e.g. KernelLHSPoisson main.cpp:9197,
+KernelDissipation main.cpp:10347); 5th-order 6-point biased-upwind advection
+derivatives (KernelAdvectDiffuse, main.cpp:9474-9548).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def shift(ap: jnp.ndarray, w: int, ox: int = 0, oy: int = 0, oz: int = 0):
+    """Interior view of padded array `ap`, shifted by (ox,oy,oz) cells."""
+    nx = ap.shape[0] - 2 * w
+    ny = ap.shape[1] - 2 * w
+    nz = ap.shape[2] - 2 * w
+    return ap[
+        w + ox : w + ox + nx,
+        w + oy : w + oy + ny,
+        w + oz : w + oz + nz,
+    ]
+
+
+def _offsets(axis: int, k: int):
+    o = [0, 0, 0]
+    o[axis] = k
+    return tuple(o)
+
+
+def d1_central(ap, w, axis, h):
+    """2nd-order centered first derivative along `axis`."""
+    return (shift(ap, w, *_offsets(axis, 1)) - shift(ap, w, *_offsets(axis, -1))) / (
+        2.0 * h
+    )
+
+
+def d1_upwind5(ap, w, axis, vel, h):
+    """5th-order 6-point biased-upwind first derivative, selected by the
+    sign of `vel` — the reference's advective derivative
+    (KernelAdvectDiffuse, main.cpp:9474-9483).
+
+    vel > 0: (-2 q[-3] + 15 q[-2] - 60 q[-1] + 20 q[0] + 30 q[+1] - 3 q[+2]) / 60h
+    vel < 0: ( 2 q[+3] - 15 q[+2] + 60 q[+1] - 20 q[0] - 30 q[-1] + 3 q[-2]) / 60h
+    Requires w >= 3.
+    """
+    qm3 = shift(ap, w, *_offsets(axis, -3))
+    qm2 = shift(ap, w, *_offsets(axis, -2))
+    qm1 = shift(ap, w, *_offsets(axis, -1))
+    q0 = shift(ap, w)
+    qp1 = shift(ap, w, *_offsets(axis, 1))
+    qp2 = shift(ap, w, *_offsets(axis, 2))
+    qp3 = shift(ap, w, *_offsets(axis, 3))
+    inv60h = 1.0 / (60.0 * h)
+    dplus = (
+        -2.0 * qm3 + 15.0 * qm2 - 60.0 * qm1 + 20.0 * q0 + 30.0 * qp1 - 3.0 * qp2
+    ) * inv60h
+    dminus = (
+        2.0 * qp3 - 15.0 * qp2 + 60.0 * qp1 - 20.0 * q0 - 30.0 * qm1 + 3.0 * qm2
+    ) * inv60h
+    return jnp.where(vel > 0, dplus, dminus)
+
+
+def laplacian(ap, w, h):
+    """7-point Laplacian of a padded scalar (w >= 1)."""
+    out = -6.0 * shift(ap, w)
+    for axis in range(3):
+        out = out + shift(ap, w, *_offsets(axis, 1)) + shift(ap, w, *_offsets(axis, -1))
+    return out / (h * h)
+
+
+def grad(ap, w, h):
+    """(nx,ny,nz,3) centered gradient of a padded scalar."""
+    return jnp.stack([d1_central(ap, w, a, h) for a in range(3)], axis=-1)
+
+
+def divergence(up, w, h):
+    """Centered divergence of a padded (.., 3) vector field."""
+    return sum(d1_central(up[..., a], w, a, h) for a in range(3))
+
+
+def curl(up, w, h):
+    """Centered curl (vorticity) of a padded (.., 3) vector field."""
+    d = lambda c, a: d1_central(up[..., c], w, a, h)
+    wx = d(2, 1) - d(1, 2)
+    wy = d(0, 2) - d(2, 0)
+    wz = d(1, 0) - d(0, 1)
+    return jnp.stack([wx, wy, wz], axis=-1)
+
+
+def vector_laplacian(up, w, h):
+    return jnp.stack([laplacian(up[..., c], w, h) for c in range(3)], axis=-1)

@@ -1,0 +1,27 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+The image's sitecustomize imports jax and registers the TPU ("axon") PJRT
+plugin before pytest starts, and the environment pins JAX_PLATFORMS=axon —
+so mutating os.environ here is too late for the platform choice.  Instead:
+
+- jax.config.update("jax_platforms", "cpu") redirects the (not yet
+  initialized) backend selection to CPU, keeping tests hermetic and
+  independent of the TPU tunnel's health;
+- XLA_FLAGS must still be set before the *CPU client* is created, which
+  happens at the first traced op — conftest import is early enough.
+
+All tests run in float32 (the TPU solver dtype); tolerance constants in the
+tests reflect that.
+"""
+
+import os
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,49 @@
+"""Taylor-Green vortex: exact Navier-Stokes solution as the correctness
+anchor for advection-diffusion + projection (SURVEY.md section 7, stage 1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.grid.uniform import BC, UniformGrid
+from cup3d_tpu.ops.advection import rk3_step
+from cup3d_tpu.ops.poisson import build_spectral_solver
+from cup3d_tpu.ops.projection import project
+
+
+def tgv_velocity(x, t, nu):
+    decay = np.exp(-2.0 * nu * t)
+    u = np.sin(x[..., 0]) * np.cos(x[..., 1]) * decay
+    v = -np.cos(x[..., 0]) * np.sin(x[..., 1]) * decay
+    w = np.zeros_like(u)
+    return jnp.stack([jnp.asarray(u), jnp.asarray(v), jnp.asarray(w)], axis=-1)
+
+
+def test_taylor_green_decay():
+    n = 32
+    nu = 0.05
+    g = UniformGrid((n, n, n), (2 * np.pi,) * 3, (BC.periodic,) * 3)
+    x = np.asarray(g.cell_centers())
+    u = tgv_velocity(x, 0.0, nu).astype(jnp.float32)
+    solve = build_spectral_solver(g)
+    uinf = jnp.zeros(3, dtype=jnp.float32)
+
+    dt = 0.01
+    nsteps = 50
+
+    @jax.jit
+    def step(u):
+        u = rk3_step(g, u, dt, nu, uinf)
+        u, _ = project(g, u, dt, solve)
+        return u
+
+    for _ in range(nsteps):
+        u = step(u)
+
+    exact = np.asarray(tgv_velocity(x, nsteps * dt, nu))
+    err = np.max(np.abs(np.asarray(u) - exact))
+    assert err < 2e-2, f"TGV error {err}"
+    # energy must decay monotonically close to exp(-4 nu t)
+    ke = float(jnp.mean(jnp.sum(u * u, axis=-1)))
+    ke_exact = float(np.mean(np.sum(exact**2, axis=-1)))
+    assert abs(ke - ke_exact) / ke_exact < 2e-2

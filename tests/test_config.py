@@ -1,0 +1,64 @@
+import pytest
+
+from cup3d_tpu.config import (
+    SimulationConfig,
+    parse_args,
+    parse_config_file,
+    parse_factory,
+)
+
+
+def test_basic_flags():
+    c = parse_args("-bpdx 2 -bpdy 4 -levelMax 3 -CFL 0.4 -nu 0.001".split())
+    assert (c.bpdx, c.bpdy, c.levelMax, c.CFL, c.nu) == (2, 4, 3, 0.4, 0.001)
+    assert c.levelStart == 2  # defaults to levelMax-1
+
+
+def test_first_occurrence_wins():
+    # CLI tokens precede config-file tokens; first wins = CLI priority
+    c = parse_args(["-CFL", "0.5", "-CFL", "0.9"])
+    assert c.CFL == 0.5
+
+
+def test_valueless_flag_is_true():
+    c = parse_args(["-verbose"])
+    assert c.verbose is True
+
+
+def test_multitoken_value_and_negative_numbers():
+    c = parse_args(["-uinf", "0.1", "-0.2", "0.0"])
+    assert c.uinf == (0.1, -0.2, 0.0)
+
+
+def test_append_only_for_strings():
+    c = parse_args(
+        ["-factory-content", "stefanfish L=0.4", "+factory-content", "xpos=0.3"]
+    )
+    assert c.factory_content == "stefanfish L=0.4 xpos=0.3"
+    with pytest.raises(ValueError):
+        parse_args(["-levelMax", "3", "+levelMax", "4"])
+
+
+def test_unknown_flag_raises():
+    with pytest.raises(ValueError):
+        parse_args(["-bogus", "1"])
+
+
+def test_config_file_comments():
+    toks = parse_config_file("-bpdx 2  # blocks\n\n# full line comment\n-CFL 0.3\n")
+    assert toks == ["-bpdx", "2", "-CFL", "0.3"]
+
+
+def test_factory_lines():
+    specs = parse_factory(
+        "stefanfish L=0.4 T=1.0 xpos=0.2\nstefanfish L=0.4 xpos=0.6 bFixFrameOfRef=1\n"
+    )
+    assert len(specs) == 2
+    assert specs[0]["type"] == "stefanfish"
+    assert specs[1]["bFixFrameOfRef"] == "1"
+
+
+def test_extents_follow_largest_axis():
+    c = SimulationConfig(bpdx=4, bpdy=2, bpdz=1, extent=1.0)
+    assert c.extents == (1.0, 0.5, 0.25)
+    assert c.uniform_shape(0) == (32, 16, 8)

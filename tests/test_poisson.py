@@ -1,0 +1,87 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup3d_tpu.grid.uniform import BC, UniformGrid
+from cup3d_tpu.ops import stencils as st
+from cup3d_tpu.ops.poisson import build_spectral_solver, dct2_matrix
+
+
+def residual(grid, p, rhs):
+    lap = st.laplacian(grid.pad_scalar(p, 1), 1, grid.h)
+    r = np.asarray(lap - (rhs - jnp.mean(rhs)))
+    return np.max(np.abs(r)) / max(np.max(np.abs(np.asarray(rhs))), 1e-30)
+
+
+def test_dct_matrix_orthogonal():
+    c = dct2_matrix(16)
+    np.testing.assert_allclose(c @ c.T, np.eye(16), atol=1e-12)
+
+
+def test_dct_matches_scipy():
+    from scipy.fft import dct
+
+    x = np.random.RandomState(0).randn(16)
+    mine = dct2_matrix(16) @ x
+    ref = dct(x, type=2, norm="ortho")
+    np.testing.assert_allclose(mine, ref, atol=1e-12)
+
+
+@pytest.mark.parametrize(
+    "bc",
+    [
+        (BC.periodic, BC.periodic, BC.periodic),
+        (BC.wall, BC.wall, BC.wall),
+        (BC.periodic, BC.wall, BC.freespace),
+    ],
+)
+def test_spectral_solver_residual(bc):
+    n = 32
+    g = UniformGrid((n, n, n), (1.0, 1.0, 1.0), bc)
+    rng = np.random.RandomState(1)
+    rhs = jnp.asarray(rng.randn(n, n, n), dtype=jnp.float32)
+    rhs = rhs - jnp.mean(rhs)
+    solve = build_spectral_solver(g, operator="compact")
+    p = solve(rhs)
+    assert residual(g, p, rhs) < 1e-4  # f32 spectral: machine-level
+
+
+def _bandlimited_field(n, seed, kmax):
+    """Random smooth field with no content at/above kmax (centered stencils
+    cannot see the Nyquist mode, so band-limit the test input)."""
+    rng = np.random.RandomState(seed)
+    u = rng.randn(n, n, n, 3)
+    uh = np.fft.fftn(u, axes=(0, 1, 2))
+    k = np.fft.fftfreq(n) * n
+    mask = (
+        (np.abs(k)[:, None, None] < kmax)
+        & (np.abs(k)[None, :, None] < kmax)
+        & (np.abs(k)[None, None, :] < kmax)
+    )
+    uh *= mask[..., None]
+    return np.real(np.fft.ifftn(uh, axes=(0, 1, 2))).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "bc",
+    [
+        (BC.periodic, BC.periodic, BC.periodic),
+        (BC.wall, BC.wall, BC.wall),
+    ],
+)
+def test_solver_removes_divergence(bc):
+    from cup3d_tpu.ops.projection import project
+
+    n = 32
+    g = UniformGrid((n, n, n), (2 * np.pi,) * 3, bc)
+    u = jnp.asarray(_bandlimited_field(n, 2, n // 3))
+    solve = build_spectral_solver(g)
+    dt = 0.1
+    u2, p = project(g, u, dt, solve)
+    div = np.asarray(st.divergence(g.pad_vector(u2, 1), 1, g.h))
+    div0 = np.asarray(st.divergence(g.pad_vector(u, 1), 1, g.h))
+    # With walls, a net boundary flux (the constant mode of div) is in the
+    # nullspace of the Neumann operator; projection cannot and must not
+    # touch it.  Everything else must vanish to f32 roundoff.
+    div = div - np.mean(div0)
+    assert np.max(np.abs(div)) < 1e-4 * np.max(np.abs(div0))

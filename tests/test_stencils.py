@@ -1,0 +1,89 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup3d_tpu.grid.uniform import BC, UniformGrid
+from cup3d_tpu.ops import stencils as st
+
+
+def make_grid(n=32, bc=BC.periodic):
+    return UniformGrid((n, n, n), (2 * np.pi,) * 3, (bc,) * 3)
+
+
+def test_laplacian_sin():
+    g = make_grid(64)
+    x = g.cell_centers()
+    f = jnp.sin(x[..., 0]) * jnp.sin(x[..., 1]) * jnp.sin(x[..., 2])
+    lap = st.laplacian(g.pad_scalar(f, 1), 1, g.h)
+    np.testing.assert_allclose(np.asarray(lap), -3 * np.asarray(f), atol=5e-2)
+
+
+def test_divergence_free_field():
+    g = make_grid(32)
+    x = g.cell_centers()
+    u = jnp.stack(
+        [
+            jnp.sin(x[..., 0]) * jnp.cos(x[..., 1]),
+            -jnp.cos(x[..., 0]) * jnp.sin(x[..., 1]),
+            jnp.zeros_like(x[..., 0]),
+        ],
+        axis=-1,
+    )
+    div = st.divergence(g.pad_vector(u, 1), 1, g.h)
+    # sin/cos discrete derivatives cancel exactly in the centered scheme
+    np.testing.assert_allclose(np.asarray(div), 0.0, atol=1e-5)
+
+
+def test_upwind5_linear_exact():
+    # 5th-order upwind is exact on polynomials up to degree 5; use linear here
+    n = 16
+    g = UniformGrid((n, n, n), (1.0, 1.0, 1.0), (BC.periodic,) * 3)
+    x = g.cell_centers()
+    f = 2.0 * x[..., 0]
+    fp = g.pad_scalar(f, 3)
+    d = st.d1_upwind5(fp, 3, 0, jnp.ones_like(f), g.h)
+    interior = np.asarray(d)[3:-3, :, :]
+    np.testing.assert_allclose(interior, 2.0, rtol=1e-4)
+
+
+def test_upwind5_cubic_exact():
+    n = 16
+    g = UniformGrid((n, n, n), (1.0, 1.0, 1.0), (BC.periodic,) * 3)
+    x = np.asarray(g.cell_centers())[..., 0]
+    f = jnp.asarray(x**3)
+    fp = g.pad_scalar(f, 3)
+    for sgn in (1.0, -1.0):
+        d = st.d1_upwind5(fp, 3, 0, sgn * jnp.ones_like(f), g.h)
+        interior = np.asarray(d)[3:-3, :, :]
+        expect = 3.0 * x[3:-3, :, :] ** 2
+        np.testing.assert_allclose(interior, expect, atol=1e-4)
+
+
+def test_curl_of_rigid_rotation():
+    g = make_grid(32)
+    x = g.cell_centers() - np.pi
+    # u = omega x r with omega = (0,0,1) -> curl = (0,0,2)
+    u = jnp.stack([-x[..., 1], x[..., 0], jnp.zeros_like(x[..., 0])], axis=-1)
+    c = st.curl(g.pad_vector(u, 1), 1, g.h)
+    interior = np.asarray(c)[2:-2, 2:-2, 2:-2]
+    np.testing.assert_allclose(interior[..., 2], 2.0, atol=1e-4)
+    np.testing.assert_allclose(interior[..., 0], 0.0, atol=1e-4)
+
+
+def test_wall_bc_ghost_sign():
+    n = 8
+    g = UniformGrid((n, n, n), (1.0, 1.0, 1.0), (BC.wall,) * 3)
+    u = jnp.ones((n, n, n, 3))
+    up = g.pad_vector(u, 1)
+    assert np.asarray(up)[0, 1, 1, 0] == -1.0  # ghost flipped
+    assert np.asarray(up)[1, 1, 1, 0] == 1.0
+
+
+def test_freespace_bc_only_normal_flips():
+    n = 8
+    g = UniformGrid((n, n, n), (1.0, 1.0, 1.0), (BC.freespace,) * 3)
+    u = jnp.ones((n, n, n, 3))
+    up = g.pad_vector(u, 1)
+    # x-face: normal (c=0) flips, tangential (c=1) copies
+    assert np.asarray(up)[0, 3, 3, 0] == -1.0
+    assert np.asarray(up)[0, 3, 3, 1] == 1.0
