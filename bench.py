@@ -1,0 +1,65 @@
+"""Headline benchmark: cell-updates/sec for one full NS timestep
+(RK3 advection-diffusion + spectral pressure projection) on a 256^3
+uniform grid — BASELINE.md config #3's resolution, obstacle-free.
+
+Prints ONE JSON line.  `vs_baseline` compares against 1.3e8 cell-updates/s,
+a documented estimate for the reference on 64 MPI ranks (the reference
+publishes no numbers and cannot be built here — no mpicxx/GSL; CubismUP-class
+codes sustain ~2e6 cell-updates/s/core on full NS steps at matched Poisson
+tolerance, see BASELINE.md).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_CELLS_PER_SEC = 1.3e8  # 64-rank MPI CPU estimate (see module docstring)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from cup3d_tpu.grid.uniform import BC, UniformGrid
+    from cup3d_tpu.ops.poisson import build_spectral_solver
+    from cup3d_tpu.sim.fused import make_step
+
+    n = int(os.environ.get("CUP3D_BENCH_N", "256"))  # override for CPU smoke
+    grid = UniformGrid((n, n, n), (2 * np.pi,) * 3, (BC.periodic,) * 3)
+    solver = build_spectral_solver(grid)
+    step = make_step(grid, nu=1e-3, solver=solver)
+
+    from cup3d_tpu.utils.flows import taylor_green_2d
+
+    vel = taylor_green_2d(grid)  # built on device, no big host transfer
+    dt = jnp.float32(1e-3)
+    uinf = jnp.zeros(3, jnp.float32)
+
+    for _ in range(3):  # warmup + compile
+        vel, p = step(vel, dt, uinf)
+    vel.block_until_ready()
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        vel, p = step(vel, dt, uinf)
+    vel.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    cells_per_sec = n ** 3 * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"cell-updates/sec ({n}^3 uniform NS step, RK3+projection)",
+                "value": round(cells_per_sec, 1),
+                "unit": "cells/s",
+                "vs_baseline": round(cells_per_sec / BASELINE_CELLS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -167,7 +167,9 @@ def parse_args(argv: List[str]) -> SimulationConfig:
         if append:
             if fields[key].type not in ("str", str):
                 raise ValueError(f"'+' append is only valid for string flags: {tok!r}")
-            raw[key] = f"{raw[key]} {value}" if key in raw else value
+            # newline-join so '+factory-content' appends form separate
+            # obstacle lines (parse_factory also splits on bare type tokens)
+            raw[key] = f"{raw[key]}\n{value}" if key in raw else value
         elif key not in raw:
             raw[key] = value
     kwargs = {k: _coerce(fields[k], v) for k, v in raw.items()}
@@ -200,20 +202,29 @@ def parse_config_file(text: str) -> List[str]:
 
 
 def parse_factory(content: str) -> List[dict]:
-    """factory-content -> one {key: value} dict per obstacle line
+    """factory-content -> one {key: value} dict per obstacle
     (FactoryFileLineParser, main.cpp:8947-8958; ObstacleFactory
-    main.cpp:13247-13289)."""
-    out = []
+    main.cpp:13247-13289).
+
+    Obstacles are separated by newlines; additionally any bare (non
+    key=value) token starts a new obstacle, so space-joined multi-obstacle
+    strings parse too.
+    """
+    out: List[dict] = []
     for line in content.splitlines():
         line = line.split("#", 1)[0].strip()
         if not line:
             continue
-        toks = shlex.split(line)
-        spec = {"type": toks[0]}
-        for tok in toks[1:]:
-            if "=" not in tok:
-                raise ValueError(f"factory token {tok!r} is not key=value")
-            k, v = tok.split("=", 1)
-            spec[k] = v
-        out.append(spec)
+        for tok in shlex.split(line):
+            if "=" in tok:
+                if not out:
+                    raise ValueError(f"factory token {tok!r} before obstacle type")
+                k, v = tok.split("=", 1)
+                out[-1][k] = v
+            elif tok[0].isalpha():
+                out.append({"type": tok})
+            else:
+                raise ValueError(
+                    f"factory token {tok!r} is neither key=value nor an obstacle type"
+                )
     return out

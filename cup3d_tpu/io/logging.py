@@ -1,0 +1,64 @@
+"""Buffered per-file logging: the reference's ``BufferedLogger``
+(main.cpp:7232-7245, 10300-10345) — named append-only text streams flushed
+every ``flush_every`` writes — plus a tiny wall-clock profiler the reference
+lacks (SURVEY.md section 5 calls for per-operator timing from day one).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List
+
+
+class BufferedLogger:
+    def __init__(self, directory: str = ".", flush_every: int = 100):
+        self.directory = directory
+        self.flush_every = flush_every
+        self._buffers: Dict[str, List[str]] = defaultdict(list)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    def write(self, filename: str, text: str) -> None:
+        self._buffers[filename].append(text)
+        self._counts[filename] += 1
+        if self._counts[filename] % self.flush_every == 0:
+            self.flush(filename)
+
+    def flush(self, filename: str | None = None) -> None:
+        names = [filename] if filename else list(self._buffers)
+        os.makedirs(self.directory, exist_ok=True)
+        for name in names:
+            buf = self._buffers.get(name)
+            if not buf:
+                continue
+            with open(os.path.join(self.directory, name), "a") as f:
+                f.write("".join(buf))
+            buf.clear()
+
+
+class Profiler:
+    """Accumulates wall-clock per named section; `report()` returns a table."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def __call__(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> str:
+        total = sum(self.totals.values()) or 1.0
+        lines = [f"{'section':<28}{'calls':>8}{'total_s':>12}{'share':>8}"]
+        for name, t in sorted(self.totals.items(), key=lambda kv: -kv[1]):
+            lines.append(
+                f"{name:<28}{self.counts[name]:>8}{t:>12.4f}{t / total:>8.1%}"
+            )
+        return "\n".join(lines)

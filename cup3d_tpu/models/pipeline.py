@@ -1,0 +1,117 @@
+"""Obstacle operators in the timestep pipeline (reference order,
+main.cpp:15229-15246): CreateObstacles -> ... -> UpdateObstacles ->
+Penalization -> PressureProjection -> ComputeForces.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.models.base import force_integrals, momentum_integrals
+from cup3d_tpu.ops.penalization import penalize
+from cup3d_tpu.sim.data import SimulationData
+from cup3d_tpu.sim.operators import Operator
+
+_EPS = 1e-6
+
+
+class CreateObstacles(Operator):
+    """Shape kinematics -> SDF -> chi/udef, then combine obstacle fields
+    (reference CreateObstacles, main.cpp:13589-13621)."""
+
+    def __call__(self, dt):
+        s = self.sim
+        self._update_uinf()
+        for ob in s.obstacles:
+            ob.update_shape(s.time, dt)
+            ob.create(s.time)
+        chis = jnp.stack([ob.chi for ob in s.obstacles])
+        s.state["chi"] = jnp.max(chis, axis=0)
+        num = sum(ob.chi[..., None] * ob.udef for ob in s.obstacles)
+        den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
+        s.state["udef"] = num / den
+
+    def _update_uinf(self):
+        """Frame-fixed swimming: uinf counteracts the tracked obstacle's
+        translational velocity (ObstacleVector::updateUinf,
+        main.cpp:8507-8519)."""
+        s = self.sim
+        fixed = [ob for ob in s.obstacles if ob.bFixFrameOfRef]
+        if fixed:
+            s.uinf = -np.mean([ob.transVel for ob in fixed], axis=0)
+
+
+class UpdateObstacles(Operator):
+    """chi-weighted fluid momenta -> 6x6 solve -> rigid-body update
+    (reference UpdateObstacles, main.cpp:13812-13837)."""
+
+    def __init__(self, sim: SimulationData):
+        super().__init__(sim)
+        self._moments = jax.jit(partial(momentum_integrals, sim.grid))
+
+    def __call__(self, dt):
+        s = self.sim
+        for ob in s.obstacles:
+            m = self._moments(ob.chi, s.state["vel"],
+                              jnp.asarray(ob.centerOfMass, s.dtype))
+            moments = {k: np.asarray(v, dtype=np.float64) for k, v in m.items()}
+            ob.compute_velocities(moments)
+            ob.update(dt)
+
+
+class Penalization(Operator):
+    """Brinkman forcing toward the combined body velocity field
+    (reference Penalization, main.cpp:14326-14341).  Collision handling
+    (main.cpp:13939-14325) is applied in UpdateObstacles order upstream;
+    here pending (see SURVEY.md section 2 L3b: Collision)."""
+
+    def __init__(self, sim: SimulationData):
+        super().__init__(sim)
+        self._penalize = jax.jit(penalize)
+
+    def __call__(self, dt):
+        s = self.sim
+        if not s.obstacles:
+            return
+        chis = jnp.stack([ob.chi for ob in s.obstacles])
+        num = sum(ob.chi[..., None] * ob.body_velocity_field() for ob in s.obstacles)
+        den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
+        ubody = num / den
+        s.state["vel"] = self._penalize(
+            s.state["vel"], s.state["chi"], ubody,
+            jnp.asarray(s.lambda_penal, s.dtype), jnp.asarray(dt, s.dtype),
+        )
+
+
+class ComputeForces(Operator):
+    """Surface tractions -> per-obstacle force/torque/power QoI, appended to
+    forces_<i>.txt (reference ComputeForces, main.cpp:12496-12503,
+    reduction 13079-13115)."""
+
+    def __init__(self, sim: SimulationData):
+        super().__init__(sim)
+        self._forces = jax.jit(partial(force_integrals, sim.grid, nu=sim.nu))
+
+    def __call__(self, dt):
+        s = self.sim
+        for i, ob in enumerate(s.obstacles):
+            f = self._forces(
+                chi=ob.chi, p=s.state["p"], vel=s.state["vel"],
+                cm=jnp.asarray(ob.centerOfMass, s.dtype),
+                ubody=ob.body_velocity_field(),
+            )
+            ob.pres_force = np.asarray(f["pres_force"], np.float64)
+            ob.visc_force = np.asarray(f["visc_force"], np.float64)
+            ob.force = ob.pres_force + ob.visc_force
+            ob.torque = np.asarray(f["torque"], np.float64)
+            ob.pow_out = float(f["power"])
+            s.logger.write(
+                f"forces_{i}.txt",
+                f"{s.time:.8e} " + " ".join(f"{v:.8e}" for v in ob.force)
+                + f" {ob.pow_out:.8e}\n",
+            )

@@ -1,0 +1,28 @@
+"""Sphere obstacle: the simplest concrete body.
+
+Not present in the condensed reference (whose factory only builds StefanFish,
+main.cpp:13235-13246) but part of upstream CubismUP_3D's obstacle family;
+it exercises the full chi -> penalization -> 6-DOF -> forces pipeline with an
+analytic SDF, and flow past a sphere is the classic drag validation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.models.base import Obstacle
+
+
+class Sphere(Obstacle):
+    def __init__(self, sim, spec):
+        super().__init__(sim, spec)
+        self.radius = float(spec.get("radius", self.length / 2))
+
+    def rasterize(self, t: float):
+        grid = self.sim.grid
+        x = grid.cell_centers(self.sim.dtype)
+        pos = jnp.asarray(self.position, self.sim.dtype)
+        d = jnp.linalg.norm(x - pos, axis=-1)
+        sdf = self.radius - d  # > 0 inside
+        return sdf, None

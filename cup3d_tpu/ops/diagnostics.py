@@ -1,0 +1,75 @@
+"""Diagnostic kernels: vorticity, Q-criterion, divergence, dissipation,
+max-velocity — the reference's diagnostics operators (ComputeVorticity
+main.cpp:8624-8745, ComputeQcriterion 8746-8788, ComputeDivergence
+8789-8919, KernelDissipation 10347-10435, findMaxU 8603-8623) as fused
+dense reductions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from cup3d_tpu.grid.uniform import UniformGrid
+from cup3d_tpu.ops import stencils as st
+
+
+def vorticity(grid: UniformGrid, u: jnp.ndarray) -> jnp.ndarray:
+    return st.curl(grid.pad_vector(u, 1), 1, grid.h)
+
+
+def q_criterion(grid: UniformGrid, u: jnp.ndarray) -> jnp.ndarray:
+    """Q = 0.5 (|Omega|^2 - |S|^2), positive in vortex cores."""
+    up = grid.pad_vector(u, 1)
+    h = grid.h
+    g = [[st.d1_central(up[..., c], 1, a, h) for a in range(3)] for c in range(3)]
+    omega2 = jnp.zeros_like(g[0][0])
+    s2 = jnp.zeros_like(g[0][0])
+    for c in range(3):
+        for a in range(3):
+            s = 0.5 * (g[c][a] + g[a][c])
+            o = 0.5 * (g[c][a] - g[a][c])
+            s2 = s2 + s * s
+            omega2 = omega2 + o * o
+    return 0.5 * (omega2 - s2)
+
+
+def divergence_field(grid: UniformGrid, u: jnp.ndarray) -> jnp.ndarray:
+    return st.divergence(grid.pad_vector(u, 1), 1, grid.h)
+
+
+def divergence_norms(grid: UniformGrid, u: jnp.ndarray):
+    """(sum |div u| h^3, max |div u|) — the reference appends the former to
+    div.txt every call (main.cpp:8911-8917)."""
+    d = divergence_field(grid, u)
+    vol = grid.h ** 3
+    return jnp.sum(jnp.abs(d)) * vol, jnp.max(jnp.abs(d))
+
+
+def max_velocity(u: jnp.ndarray, uinf: jnp.ndarray) -> jnp.ndarray:
+    """max over cells of max-norm of lab-frame velocity (findMaxU)."""
+    return jnp.max(jnp.abs(u + uinf))
+
+
+def dissipation(grid: UniformGrid, u: jnp.ndarray, nu: float) -> Dict[str, jnp.ndarray]:
+    """Energy-budget integrals (KernelDissipation semantics):
+
+    kinetic energy  0.5 |u|^2, enstrophy 0.5 |omega|^2, viscous dissipation
+    rate 2 nu S:S — each integrated over the domain with cell volume h^3.
+    """
+    up = grid.pad_vector(u, 1)
+    h = grid.h
+    g = [[st.d1_central(up[..., c], 1, a, h) for a in range(3)] for c in range(3)]
+    ss = jnp.zeros_like(g[0][0])
+    for c in range(3):
+        for a in range(3):
+            s = 0.5 * (g[c][a] + g[a][c])
+            ss = ss + s * s
+    w = st.curl(up, 1, h)
+    vol = h ** 3
+    return {
+        "kinetic_energy": 0.5 * jnp.sum(jnp.sum(u * u, axis=-1)) * vol,
+        "enstrophy": 0.5 * jnp.sum(jnp.sum(w * w, axis=-1)) * vol,
+        "dissipation_rate": 2.0 * nu * jnp.sum(ss) * vol,
+    }

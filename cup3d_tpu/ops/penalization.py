@@ -1,0 +1,27 @@
+"""Brinkman penalization (reference Penalization kernel,
+main.cpp:13841-13912).
+
+Implicit form: u^{n+1} = u + (lambda chi dt / (1 + lambda chi dt)) (u_body - u),
+where u_body = u_trans + omega x r + u_def is the obstacle's local solid-body
++ deformation velocity.  Operating on the dense chi/ubody fields makes this a
+single fused elementwise kernel over the whole domain.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def penalize(vel: jnp.ndarray, chi: jnp.ndarray, ubody: jnp.ndarray,
+             lam, dt) -> jnp.ndarray:
+    """vel, ubody: (...,3); chi in [0,1]; lam, dt scalars."""
+    x = lam * dt * chi
+    fac = (x / (1.0 + x))[..., None]
+    return vel + fac * (ubody - vel)
+
+
+def penalization_force(vel_new: jnp.ndarray, vel_old: jnp.ndarray, dt,
+                       h: float) -> jnp.ndarray:
+    """Instantaneous penalization force density integrand
+    F = (u^{n+1} - u^n)/dt * h^3 (reference force reduction, main.cpp:13913-13938)."""
+    return (vel_new - vel_old) * (h ** 3 / dt)

@@ -2,20 +2,28 @@
 
 The reference solves ``lap p = rhs`` with a pipelined BiCGSTAB + per-block CG
 preconditioner (PoissonSolverAMR, main.cpp:14363-14616).  On a *uniform* TPU
-grid we can do strictly better: the 7-point Laplacian with
-periodic/zero-gradient boundaries is diagonalized exactly by FFTs (periodic
-axes) and DCT-II transforms (Neumann axes).  The DCT is applied as a dense
-cosine-basis matmul — an orthogonal transform whose inverse is its transpose
-— which maps straight onto the MXU and is exact to machine precision, with
-O(N) extra flops per cell that the systolic array absorbs.
+grid we can do strictly better: the discrete Laplacian with periodic /
+zero-gradient boundaries is diagonalized exactly by per-axis orthonormal
+transforms — the real Fourier basis (periodic axes) and the DCT-II basis
+(Neumann axes).  Both are applied as dense basis matmuls: an N x N orthogonal
+matrix per axis, inverse = transpose.  This maps the entire solve onto the
+MXU (6 large matmuls + one elementwise scale), works identically under SPMD
+sharding (no FFT layout constraints), is exact to machine precision, and
+costs O(N) flops/cell that the systolic array absorbs.
 
-Discrete eigenvalues per axis (cell-centered, copy-edge ghosts):
+Discrete eigenvalues per axis with grid angle theta_k:
 
-- periodic: 2 cos(2 pi k / N) - 2
-- Neumann:  2 cos(pi k / N) - 2      (DCT-II basis)
+- periodic: theta_k = 2 pi k / N    (real Fourier rows: DC, cos/sin pairs,
+                                     Nyquist)
+- Neumann:  theta_k =   pi k / N    (DCT-II rows; copy-edge ghosts)
 
-The Krylov path for non-diagonalizable operators (AMR octree) lives in
-``cup3d_tpu.ops.krylov``.
+operator="compact":    7-point Laplacian        -> (2 cos theta - 2) / h^2
+operator="consistent": div(grad) of 2h-centered -> -sin(theta)^2 / h^2
+
+The consistent form makes pressure projection remove the centered divergence
+*exactly* (up to the periodic Nyquist mode, invisible to centered
+differencing).  The Krylov path for non-diagonalizable operators (AMR octree)
+lives in ``cup3d_tpu.ops.krylov``.
 """
 
 from __future__ import annotations
@@ -38,23 +46,42 @@ def dct2_matrix(n: int, dtype=np.float64) -> np.ndarray:
     return c.astype(dtype)
 
 
-def _axis_eigenvalues(n: int, periodic: bool, operator: str) -> np.ndarray:
-    """Per-axis eigenvalues (times h^2) of the chosen discrete Laplacian.
+def rfourier_matrix(n: int, dtype=np.float64):
+    """Orthonormal *real* Fourier basis R (n x n) and per-row frequencies.
 
-    operator="compact":    7-point Laplacian  -> 2 cos(theta) - 2
-    operator="consistent": div(grad(.)) built from 2h-centered first
-                           differences        -> -sin(theta)^2
-    The consistent form makes the pressure projection remove the centered
-    divergence *exactly* (up to the periodic Nyquist mode, which centered
-    differencing cannot see).
+    Rows: DC; then (cos, sin) pairs for k = 1..ceil(n/2)-1; plus the Nyquist
+    alternating row when n is even.  R @ R.T = I, so the inverse transform is
+    the transpose — the same matmul-only structure as the DCT path.
     """
-    k = np.arange(n)
-    theta = (2.0 * np.pi * k / n) if periodic else (np.pi * k / n)
+    j = np.arange(n)
+    rows = [np.full(n, 1.0 / np.sqrt(n))]
+    freqs = [0]
+    for k in range(1, (n + 1) // 2):
+        rows.append(np.sqrt(2.0 / n) * np.cos(2 * np.pi * k * j / n))
+        freqs.append(k)
+        rows.append(np.sqrt(2.0 / n) * np.sin(2 * np.pi * k * j / n))
+        freqs.append(k)
+    if n % 2 == 0:
+        rows.append(((-1.0) ** j) / np.sqrt(n))
+        freqs.append(n // 2)
+    return np.stack(rows).astype(dtype), np.asarray(freqs)
+
+
+def _axis_spectrum(n: int, periodic: bool, operator: str):
+    """(basis matrix, eigenvalues*h^2) for one axis; f64 construction."""
+    if periodic:
+        mat, freqs = rfourier_matrix(n)
+        theta = 2.0 * np.pi * freqs / n
+    else:
+        mat = dct2_matrix(n)
+        theta = np.pi * np.arange(n) / n
     if operator == "compact":
-        return 2.0 * np.cos(theta) - 2.0
-    if operator == "consistent":
-        return -np.sin(theta) ** 2
-    raise ValueError(operator)
+        lam = 2.0 * np.cos(theta) - 2.0
+    elif operator == "consistent":
+        lam = -np.sin(theta) ** 2
+    else:
+        raise ValueError(operator)
+    return mat, lam
 
 
 def _apply_mat(mat, f, axis):
@@ -76,9 +103,13 @@ def build_spectral_solver(grid: UniformGrid, dtype=jnp.float32,
     periodic = [b == BC.periodic for b in grid.bc]
     h = grid.h
 
-    lams = [
-        _axis_eigenvalues(n, p, operator) for n, p in zip(grid.shape, periodic)
-    ]
+    mats = []
+    lams = []
+    for n, p in zip(grid.shape, periodic):
+        mat, lam = _axis_spectrum(n, p, operator)
+        mats.append(jnp.asarray(mat, dtype=dtype))
+        lams.append(lam)
+
     lam = (
         lams[0][:, None, None] + lams[1][None, :, None] + lams[2][None, None, :]
     ) / (h * h)
@@ -88,26 +119,13 @@ def build_spectral_solver(grid: UniformGrid, dtype=jnp.float32,
     inv[nz] = 1.0 / lam_flat[nz]
     inv = jnp.asarray(inv.reshape(lam.shape), dtype=dtype)
 
-    dct_mats = {
-        a: jnp.asarray(dct2_matrix(grid.shape[a]), dtype=dtype)
-    # transform matrices only for Neumann axes; FFT handles periodic axes
-        for a in range(3)
-        if not periodic[a]
-    }
-    fft_axes = tuple(a for a in range(3) if periodic[a])
-
     def solve(rhs: jnp.ndarray) -> jnp.ndarray:
         f = rhs.astype(dtype)
-        for a, mat in dct_mats.items():
-            f = _apply_mat(mat, f, a)
-        if fft_axes:
-            f = jnp.fft.fftn(f, axes=fft_axes)
+        for a in range(3):
+            f = _apply_mat(mats[a], f, a)
         f = f * inv
-        if fft_axes:
-            f = jnp.fft.ifftn(f, axes=fft_axes)
-            f = jnp.real(f)
-        for a, mat in dct_mats.items():
-            f = _apply_mat(mat.T, f, a)
+        for a in range(3):
+            f = _apply_mat(mats[a].T, f, a)
         p = f.astype(rhs.dtype)
         return p - jnp.mean(p)
 

@@ -1,0 +1,6 @@
+from cup3d_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    field_sharding,
+    scalar_sharding,
+    shard_field,
+)

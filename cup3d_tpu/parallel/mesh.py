@@ -1,0 +1,63 @@
+"""Device-mesh spatial domain decomposition.
+
+The reference partitions the octree across MPI ranks by space-filling-curve
+order and hand-codes halo exchange (SynchronizerMPI_AMR, main.cpp:1515-2545)
+plus diffusion/global load balancing (main.cpp:4660-5022).  The TPU design
+replaces all of that machinery for the uniform path with *sharding
+annotations*: fields are laid out ``(x, y, z[, c])`` and sharded over a 2-D
+``Mesh("x", "y")``; XLA's SPMD partitioner turns the pad+slice stencils into
+neighbor collectives riding the ICI torus, and overlap of compute with halo
+communication falls out of the compiler's latency hiding instead of
+hand-written ``avail_next()`` polling (main.cpp:2329-2355).
+
+The z axis is kept unsharded so each shard's innermost (lane-aligned)
+dimension stays dense — the layout the VPU wants.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _factor2(n: int) -> Tuple[int, int]:
+    """n -> (a, b), a*b = n, as square as possible, a >= b."""
+    b = int(np.floor(np.sqrt(n)))
+    while n % b:
+        b -= 1
+    return n // b, b
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              shape: Optional[Tuple[int, int]] = None,
+              axis_names: Tuple[str, str] = ("x", "y")) -> Mesh:
+    """2-D mesh over the given (default: all) devices.
+
+    On real hardware the device order produced by jax.devices() follows the
+    physical torus, so a near-square factorization keeps both mesh axes on
+    ICI neighbors.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = _factor2(len(devices))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def field_sharding(mesh: Mesh) -> NamedSharding:
+    """(nx, ny, nz, 3) vector field: shard x and y, replicate z and c."""
+    return NamedSharding(mesh, P(*mesh.axis_names, None, None))
+
+
+def scalar_sharding(mesh: Mesh) -> NamedSharding:
+    """(nx, ny, nz) scalar field: shard x and y."""
+    return NamedSharding(mesh, P(*mesh.axis_names, None))
+
+
+def shard_field(arr, mesh: Mesh):
+    sh = field_sharding(mesh) if arr.ndim == 4 else scalar_sharding(mesh)
+    return jax.device_put(arr, sh)

@@ -1,0 +1,63 @@
+"""SimulationData: all runtime state of a run (reference main.cpp:6600-6677).
+
+The reference keeps five parallel AMR grids (chi, pres, lhs scalar; vel, tmpV
+vector).  Here the uniform-grid path keeps one dict of dense device arrays;
+``lhs``/``tmpV`` scratch fields are unnecessary because XLA materializes
+temporaries inside fused kernels.  The AMR path swaps these for block-batched
+arrays with identical keys (``cup3d_tpu.grid.blocks``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.config import SimulationConfig
+from cup3d_tpu.grid.uniform import BC, UniformGrid
+from cup3d_tpu.io.logging import BufferedLogger, Profiler
+from cup3d_tpu.ops.poisson import build_spectral_solver
+
+
+class SimulationData:
+    def __init__(self, cfg: SimulationConfig):
+        self.cfg = cfg
+        shape = cfg.uniform_shape()
+        self.grid = UniformGrid(shape, cfg.extents, tuple(BC(b) for b in cfg.bc))
+        self.dtype = jnp.dtype(cfg.dtype)
+
+        n3 = shape + (3,)
+        self.state: Dict[str, jnp.ndarray] = {
+            "vel": jnp.zeros(n3, self.dtype),
+            "chi": jnp.zeros(shape, self.dtype),
+            "p": jnp.zeros(shape, self.dtype),
+            "udef": jnp.zeros(n3, self.dtype),
+        }
+
+        self.poisson_solver: Callable = build_spectral_solver(self.grid, self.dtype)
+
+        # scalars (host side, mirroring main.cpp:15348-15387 defaults)
+        self.time: float = 0.0
+        self.step: int = 0
+        self.dt: float = 0.0
+        self.uinf = np.asarray(cfg.uinf, dtype=np.float64)
+        self.nu = cfg.nu
+        self.lambda_penal = cfg.lambda_penalization
+
+        self.obstacles: List = []  # filled by the obstacle factory
+        self.MeshChanged = True
+
+        self.logger = BufferedLogger(cfg.path4serialization)
+        self.profiler = Profiler()
+
+    @property
+    def vel(self) -> jnp.ndarray:
+        return self.state["vel"]
+
+    @property
+    def chi(self) -> jnp.ndarray:
+        return self.state["chi"]
+
+    def uinf_device(self) -> jnp.ndarray:
+        return jnp.asarray(self.uinf, dtype=self.dtype)

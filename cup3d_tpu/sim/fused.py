@@ -1,0 +1,51 @@
+"""The fused whole-timestep kernel: one jitted function covering the
+device-side pipeline advection-diffusion -> penalization -> projection.
+
+This is the TPU answer to the reference's operator-by-operator sweep over
+blocks (Simulation::advance, main.cpp:15306-15326): instead of five separate
+grid traversals with halo exchanges between them, XLA fuses the elementwise
+chains and the SPMD partitioner inserts halo exchanges only where stencils
+demand them.  Used by the benchmark, the multi-chip dry run, and the
+obstacle-free fast path of the driver.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from cup3d_tpu.grid.uniform import UniformGrid
+from cup3d_tpu.ops.advection import rk3_step
+from cup3d_tpu.ops.penalization import penalize
+from cup3d_tpu.ops.projection import project
+
+
+def make_step(grid: UniformGrid, nu: float, solver, with_bodies: bool = False,
+              jit: bool = True):
+    """Returns step(vel, dt, uinf[, chi, ubody, udef, lam]) -> (vel, p).
+
+    All runtime scalars are traced arguments, so dt/lambda changes never
+    recompile.  `with_bodies` switches in the penalization + pressure-RHS
+    obstacle terms (static switch = two compiled variants at most).
+    Pass jit=False to wrap the raw function yourself (e.g. with shardings).
+    """
+
+    if with_bodies:
+
+        def step(vel, dt, uinf, chi, ubody, udef, lam):
+            vel = rk3_step(grid, vel, dt, nu, uinf)
+            vel = penalize(vel, chi, ubody, lam, dt)
+            vel, p = project(grid, vel, dt, solver, chi, udef)
+            return vel, p
+
+    else:
+
+        def step(vel, dt, uinf):
+            vel = rk3_step(grid, vel, dt, nu, uinf)
+            vel, p = project(grid, vel, dt, solver)
+            return vel, p
+
+    return jax.jit(step) if jit else step

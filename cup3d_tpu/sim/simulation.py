@@ -1,0 +1,117 @@
+"""Simulation driver: init + timestep loop (reference Simulation,
+main.cpp:15161-15326).
+
+``simulate()`` = loop { calcMaxTimestep; advance }, with the reference's
+CFL advective/diffusive dt policy, 100-step logarithmic ramp-up, runaway-
+velocity abort, and heartbeat print (main.cpp:15247-15305).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from cup3d_tpu.config import SimulationConfig, parse_factory
+from cup3d_tpu.ops import diagnostics as diag
+from cup3d_tpu.sim import operators as ops
+from cup3d_tpu.sim.data import SimulationData
+
+
+class Simulation:
+    def __init__(self, cfg: SimulationConfig):
+        self.cfg = cfg
+        self.sim = SimulationData(cfg)
+        self.pipeline: List[ops.Operator] = []
+        self._max_u = jax.jit(diag.max_velocity)
+
+    # -- setup (reference init(), main.cpp:15163-15178) --------------------
+
+    def init(self) -> None:
+        self._setup_operators()
+        self._add_obstacles()
+        ops.initial_conditions(self.sim)
+
+    def _setup_operators(self) -> None:
+        """Pipeline order is the reference's (main.cpp:15229-15246)."""
+        s = self.sim
+        cfg = self.cfg
+        with_bodies = bool(s.obstacles or cfg.factory_content)
+        if with_bodies:
+            from cup3d_tpu.models import pipeline as body_ops
+
+        pipe: List[ops.Operator] = []
+        if with_bodies:
+            pipe.append(body_ops.CreateObstacles(s))
+        pipe.append(ops.AdvectionDiffusion(s))
+        if cfg.uMax_forced > 0 and not cfg.bFixMassFlux:
+            pipe.append(ops.ExternalForcing(s))
+        if cfg.bFixMassFlux:
+            pipe.append(ops.FixMassFlux(s))
+        if with_bodies:
+            pipe.append(body_ops.UpdateObstacles(s))
+            pipe.append(body_ops.Penalization(s))
+        pipe.append(ops.PressureProjection(s))
+        if with_bodies:
+            pipe.append(body_ops.ComputeForces(s))
+        pipe.append(ops.ComputeDissipation(s))
+        pipe.append(ops.ComputeDivergence(s))
+        self.pipeline = pipe
+
+    def _add_obstacles(self) -> None:
+        if not self.cfg.factory_content:
+            return
+        from cup3d_tpu.models.factory import make_obstacles
+
+        self.sim.obstacles = make_obstacles(self.sim, parse_factory(self.cfg.factory_content))
+
+    # -- time stepping -----------------------------------------------------
+
+    def calc_max_timestep(self) -> float:
+        """CFL dt with diffusive cap and log ramp-up (main.cpp:15254-15305)."""
+        s, cfg = self.sim, self.cfg
+        h = s.grid.h
+        umax = float(self._max_u(s.state["vel"], s.uinf_device()))
+        if umax > cfg.uMax_allowed:
+            s.logger.flush()
+            raise RuntimeError(
+                f"runaway velocity: max|u|={umax:.3g} > uMax_allowed={cfg.uMax_allowed}"
+            )
+        if cfg.dt > 0:
+            s.dt = cfg.dt
+        else:
+            cfl = cfg.CFL
+            if s.step < cfg.rampup:  # logarithmic ramp 1e-2*CFL -> CFL
+                cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - s.step / cfg.rampup))
+            dt_adv = cfl * h / max(umax, 1e-12)
+            dt_dif = 0.25 * h * h / s.nu if not cfg.implicitDiffusion else np.inf
+            s.dt = float(min(dt_adv, dt_dif))
+            if cfg.tend > 0:
+                s.dt = min(s.dt, cfg.tend - s.time)
+        # lambda = DLM/dt each step (main.cpp:15302-15303)
+        if cfg.DLM > 0:
+            s.lambda_penal = cfg.DLM / s.dt
+        return s.dt
+
+    def advance(self, dt: float) -> None:
+        s = self.sim
+        for op in self.pipeline:
+            with s.profiler(op.name):
+                op(dt)
+        s.step += 1
+        s.time += dt
+
+    def simulate(self) -> None:
+        s, cfg = self.sim, self.cfg
+        while True:
+            dt = self.calc_max_timestep()
+            if cfg.verbose:
+                print(f"cup3d_tpu: step: {s.step}, time: {s.time:f}, dt: {dt:.3e}")
+            self.advance(dt)
+            done_t = cfg.tend > 0 and s.time >= cfg.tend - 1e-12
+            done_n = cfg.nsteps > 0 and s.step >= cfg.nsteps
+            if done_t or done_n:
+                break
+        s.logger.flush()

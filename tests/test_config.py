@@ -32,9 +32,13 @@ def test_multitoken_value_and_negative_numbers():
 
 def test_append_only_for_strings():
     c = parse_args(
-        ["-factory-content", "stefanfish L=0.4", "+factory-content", "xpos=0.3"]
+        [
+            "-factory-content", "stefanfish L=0.4 xpos=0.3",
+            "+factory-content", "stefanfish L=0.4 xpos=0.7",
+        ]
     )
-    assert c.factory_content == "stefanfish L=0.4 xpos=0.3"
+    specs = parse_factory(c.factory_content)
+    assert len(specs) == 2 and specs[1]["xpos"] == "0.7"
     with pytest.raises(ValueError):
         parse_args(["-levelMax", "3", "+levelMax", "4"])
 

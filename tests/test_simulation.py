@@ -1,0 +1,68 @@
+"""Driver-level tests: config -> Simulation -> simulate()."""
+
+import numpy as np
+import pytest
+
+from cup3d_tpu.config import SimulationConfig
+from cup3d_tpu.sim.simulation import Simulation
+
+
+def test_taylor_green_driver_run(tmp_path):
+    cfg = SimulationConfig(
+        bpdx=4,
+        bpdy=4,
+        bpdz=4,
+        levelMax=1,
+        levelStart=0,
+        extent=2 * np.pi,
+        CFL=0.3,
+        nu=0.02,
+        tend=0.1,
+        rampup=0,
+        initCond="taylorGreen",
+        freqDiagnostics=2,
+        verbose=False,
+        path4serialization=str(tmp_path),
+    )
+    s = Simulation(cfg)
+    s.init()
+    ke0 = _ke(s)
+    s.simulate()
+    assert s.sim.time >= cfg.tend - 1e-9
+    assert _ke(s) < ke0  # viscous decay
+    assert (tmp_path / "div.txt").exists()
+    assert (tmp_path / "energy.txt").exists()
+    div_last = [float(v) for v in (tmp_path / "div.txt").read_text().splitlines()[-1].split()]
+    assert div_last[3] < 1e-3  # max|div u| after projection
+
+
+def _ke(s):
+    import jax.numpy as jnp
+
+    return float(jnp.mean(jnp.sum(s.sim.vel * s.sim.vel, axis=-1)))
+
+
+def test_runaway_velocity_aborts():
+    import jax.numpy as jnp
+
+    cfg = SimulationConfig(bpdx=1, bpdy=1, bpdz=1, levelMax=1, levelStart=1,
+                           uMax_allowed=0.5, rampup=0, verbose=False)
+    s = Simulation(cfg)
+    s.init()
+    s.sim.state["vel"] = s.sim.state["vel"] + 1.0
+    with pytest.raises(RuntimeError, match="runaway"):
+        s.calc_max_timestep()
+
+
+def test_dt_policy_ramp():
+    cfg = SimulationConfig(bpdx=2, bpdy=2, bpdz=2, levelMax=1, levelStart=0,
+                           CFL=0.4, nu=1e-3, rampup=10, verbose=False,
+                           initCond="taylorGreen", extent=2 * np.pi)
+    s = Simulation(cfg)
+    s.init()
+    dt0 = s.calc_max_timestep()
+    s.sim.step = 10  # past ramp
+    dt1 = s.calc_max_timestep()
+    assert dt1 > dt0  # ramp releases
+    h = s.sim.grid.h
+    assert dt1 <= 0.4 * h / 1.0 + 1e-9 or dt1 <= 0.25 * h * h / cfg.nu
