@@ -88,6 +88,9 @@ class Obstacle:
             [spec.get("bBlockRotation", "1" if forced else "0") == "1"] * 3
         )
         self.bFixFrameOfRef = spec.get("bFixFrameOfRef", "0") == "1"
+        # absolute position: not advected by the moving frame's uinf
+        # (reference absPos, main.cpp:13138-13143)
+        self.absPos = self.position.copy()
 
         # filled by create()/integrals
         self.chi: Optional[jnp.ndarray] = None
@@ -157,6 +160,7 @@ class Obstacle:
         """Advance position/orientation (reference update, main.cpp:13116-13204)."""
         uinf = self.sim.uinf
         self.position = self.position + dt * (self.transVel + uinf)
+        self.absPos = self.absPos + dt * self.transVel
         self.centerOfMass = self.centerOfMass + dt * (self.transVel + uinf)
         self.quaternion = quat_integrate(self.quaternion, self.angVel, dt)
 

@@ -1,0 +1,19 @@
+"""Fish subsystem: midline kinematics (host, NumPy) + SDF rasterization (JAX).
+
+Reference layer L3b (SURVEY.md section 2): FishMidlineData, Schedulers,
+Frenet3D, MidlineShapes, CurvatureDefinedFishData, StefanFish,
+PutFishOnBlocks (main.cpp:7586-9088, 10597-12198, 15434-15981).
+
+Split of responsibilities (TPU-first, not a port):
+
+- Everything that is a small sequential ODE / spline over the ~10^2-point
+  midline stays on host in NumPy (`interpolation`, `schedulers`, `frenet`,
+  `shapes`, `midline`, `curvature`).
+- The per-cell work -- signed distance of every grid cell to the deforming
+  body and the deformation-velocity field -- is one jitted JAX kernel over a
+  dense window (`rasterize`), replacing the reference's per-block surface
+  point scattering (PutFishOnBlocks, main.cpp:11350-11926) with a
+  vectorized distance-to-elliptical-cone-segments formulation.
+"""
+
+from cup3d_tpu.models.fish.stefanfish import StefanFish  # noqa: F401

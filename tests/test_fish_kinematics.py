@@ -1,0 +1,145 @@
+"""Host-side fish kinematics: arc grid, shapes, Frenet, schedulers,
+momentum removal (reference FishMidlineData/CurvatureDefinedFishData)."""
+
+import numpy as np
+import pytest
+
+from cup3d_tpu.models.fish.curvature import CurvatureDefinedFishData
+from cup3d_tpu.models.fish.frenet import frenet_solve
+from cup3d_tpu.models.fish.interpolation import cubic_hermite, natural_cubic_spline
+from cup3d_tpu.models.fish.midline import midline_arc_grid
+from cup3d_tpu.models.fish.schedulers import LearnWaveScheduler, ScalarScheduler
+from cup3d_tpu.models.fish import shapes
+
+
+L, T, H = 0.4, 1.0, 1.0 / 128
+
+
+def test_arc_grid():
+    rs = midline_arc_grid(L, H)
+    assert rs[0] == 0.0
+    assert abs(rs[-1] - L) < 1e-12
+    assert np.all(np.diff(rs) > 0)
+    # refined ends: first spacing ~0.125h, middle ~h/sqrt(3)
+    assert np.diff(rs)[0] < 0.3 * H
+    mid = len(rs) // 2
+    assert abs(np.diff(rs)[mid] - H / np.sqrt(3)) < 0.1 * H
+
+
+def test_natural_spline_reproduces_cubic():
+    x = np.linspace(0, 1, 12)
+    y = x**2  # spline of smooth data
+    xq = np.linspace(0.05, 0.95, 50)
+    yq = natural_cubic_spline(x, y, xq)
+    assert np.max(np.abs(yq - xq**2)) < 2e-3
+
+
+def test_cubic_hermite_endpoints():
+    y0, dy0 = cubic_hermite(0.0, 1.0, 0.0, 2.0, 5.0, 1.0, 0.0)
+    y1, dy1 = cubic_hermite(0.0, 1.0, 1.0, 2.0, 5.0, 1.0, 0.0)
+    assert abs(y0 - 2.0) < 1e-14 and abs(dy0 - 1.0) < 1e-14
+    assert abs(y1 - 5.0) < 1e-14 and abs(dy1) < 1e-12
+
+
+def test_scalar_scheduler_transition():
+    s = ScalarScheduler()
+    s.transition_scalar(0.5, 0.5, 1.5, 1.0, 2.0)
+    v0, _ = s.get_scalar(0.5)
+    v1, _ = s.get_scalar(1.5)
+    vm, dvm = s.get_scalar(1.0)
+    assert abs(v0 - 1.0) < 1e-14 and abs(v1 - 2.0) < 1e-14
+    assert 1.0 < vm < 2.0 and dvm > 0
+
+
+def test_learnwave_turn_travels():
+    s = LearnWaveScheduler(7)
+    s.turn(0.5, 1.0)
+    pos = np.array([-0.5, -0.25, 0.0, 0.25, 0.5, 0.75, 1.0])
+    sf = np.linspace(0, L, 50)
+    v_early, _ = s.get_fine(1.05, T, L, pos, sf)
+    v_late, _ = s.get_fine(1.45, T, L, pos, sf)
+    # the bend propagates toward the tail as t grows
+    assert np.argmax(np.abs(v_late)) > np.argmax(np.abs(v_early))
+
+
+def test_frenet_straight_and_circle():
+    rs = np.linspace(0, 1, 200)
+    z = np.zeros_like(rs)
+    out = frenet_solve(rs, z, z, z, z)
+    assert np.allclose(out["r"][:, 0], rs, atol=1e-12)
+    assert np.allclose(out["r"][:, 1:], 0.0)
+    # constant curvature 2*pi: a unit-length circle of radius 1/(2 pi)
+    k = np.full_like(rs, 2 * np.pi)
+    out = frenet_solve(rs, k, z, z, z)
+    assert np.linalg.norm(out["r"][-1] - out["r"][0]) < 0.05
+
+
+@pytest.mark.parametrize("name,fn", [
+    ("stefan_w", lambda rs: shapes.stefan_width(L, rs)),
+    ("stefan_h", lambda rs: shapes.stefan_height(L, rs)),
+    ("larval_w", lambda rs: shapes.larval_width(L, rs)),
+    ("larval_h", lambda rs: shapes.larval_height(L, rs)),
+    ("danio_w", lambda rs: shapes.danio_width(L, rs)),
+    ("danio_h", lambda rs: shapes.danio_height(L, rs)),
+    ("naca", lambda rs: shapes.naca_width(0.12, L, rs)),
+])
+def test_profiles_positive_interior_zero_ends(name, fn):
+    rs = midline_arc_grid(L, H)
+    w = fn(rs)
+    assert w[0] == 0.0 and w[-1] == 0.0
+    assert np.all(w[1:-1] >= 0)
+    assert np.max(w) > 0.01 * L
+    assert np.max(w) < 0.5 * L
+
+
+def test_bspline_profiles():
+    rs = midline_arc_grid(L, H)
+    hgt, wid = shapes.compute_widths_heights("baseline", "baseline", L, rs)
+    assert hgt[0] == 0 and hgt[-1] == 0 and wid[0] == 0 and wid[-1] == 0
+    assert np.max(hgt) > 0.05 * L  # baseline height peaks ~0.1 L
+    assert np.max(wid) > 0.03 * L
+    assert np.all(np.isfinite(hgt)) and np.all(np.isfinite(wid))
+
+
+def test_midline_momentum_removed():
+    cf = CurvatureDefinedFishData(L, T, 0.0, H)
+    cf.height, cf.width = shapes.compute_widths_heights("baseline", "baseline",
+                                                        L, cf.rS)
+    dt = 1e-3
+    cf.compute_midline(0.37, dt)
+    cf.integrate_linear_momentum()
+    cf.integrate_angular_momentum(dt)
+    # recompute the linear integrals: they must now vanish
+    _, _, aux1, aux2, aux3 = cf._section_integrals()
+    vol = np.sum(aux1)
+    cm = (
+        np.einsum("i,ij->j", aux1, cf.r)
+        + np.einsum("i,ij->j", aux2, cf.nor)
+        + np.einsum("i,ij->j", aux3, cf.bin)
+    ) / vol
+    lm = (
+        np.einsum("i,ij->j", aux1, cf.v)
+        + np.einsum("i,ij->j", aux2, cf.vnor)
+        + np.einsum("i,ij->j", aux3, cf.vbin)
+    ) / vol
+    assert np.max(np.abs(cm)) < 1e-10 * L
+    assert np.max(np.abs(lm)) < 1e-10
+    # frames stay orthonormal
+    tan = np.gradient(cf.r, cf.rS, axis=0)
+    tan /= np.linalg.norm(tan, axis=1, keepdims=True)
+    assert np.max(np.abs(np.einsum("ij,ij->i", cf.nor, cf.bin))) < 1e-6
+
+
+def test_midline_is_periodic_wave():
+    cf = CurvatureDefinedFishData(L, T, 0.0, H)
+    cf.height, cf.width = shapes.compute_widths_heights("baseline", "baseline",
+                                                        L, cf.rS)
+    # after the amplitude ramp (t > Tperiod) the gait is periodic
+    cf.compute_midline(2.0, 1e-3)
+    r1 = cf.r.copy()
+    cf.compute_midline(3.0, 1e-3)
+    assert np.max(np.abs(cf.r - r1)) < 1e-8  # period T = 1
+    cf.compute_midline(2.5, 1e-3)
+    assert np.max(np.abs(cf.r - r1)) > 1e-3 * L  # half period differs
+    # tail-beat amplitude is a few percent of L, nonzero
+    assert 0.01 * L < np.max(np.abs(cf.r[:, 1])) < 0.5 * L
