@@ -22,7 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from cup3d_tpu.models.fish.frenet import frenet_solve
-from cup3d_tpu.models.fish.midline import FishMidlineData, _d_ds
+from cup3d_tpu.models.fish.midline import FishMidlineData
 from cup3d_tpu.models.fish.schedulers import (
     LearnWaveScheduler,
     ScalarScheduler,
@@ -79,7 +79,15 @@ class CurvatureDefinedFishData(FishMidlineData):
             self.Ttorsion_start = time
 
     def correct_tail_period(self, period_fac, period_vel, t, dt):
-        """PID tail-beat period modulation (main.cpp:9031-9043)."""
+        """PID tail-beat period modulation (main.cpp:9030-9043).
+
+        Note a deliberate divergence: the condensed reference defines
+        correctTailPeriod but never calls it, and its computeMidline
+        unconditionally overwrites periodPIDval from the period scheduler
+        (main.cpp:15467) — the API is dead there.  Here compute_midline
+        skips the scheduler overwrite while TperiodPID is active, so this
+        control entry point actually works (upstream CubismUP_3D behavior).
+        """
         last_arg = (self.lastTime - self.time0) / self.periodPIDval + self.timeshift
         self.time0 = self.lastTime
         self.timeshift = last_arg
@@ -97,7 +105,9 @@ class CurvatureDefinedFishData(FishMidlineData):
             self.transition_start + self.transition_duration,
             self.current_period, self.next_period,
         )
-        self.periodPIDval, self.periodPIDdif = self.periodScheduler.get_scalar(t)
+        if not self.TperiodPID:  # PID takeover holds the period (see
+            # correct_tail_period); otherwise the scheduler drives it
+            self.periodPIDval, self.periodPIDdif = self.periodScheduler.get_scalar(t)
         if self.transition_start < t < self.transition_start + self.transition_duration:
             self.timeshift = (t - self.time0) / self.periodPIDval + self.timeshift
             self.time0 = t

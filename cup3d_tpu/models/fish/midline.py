@@ -104,8 +104,9 @@ class FishMidlineData:
         width (along nor) and height (along bin); the volume element
         follows the reference's first-order expansion in the frame
         derivatives (main.cpp:10961-10995).
-        Returns (ds_weights, c, aux1, aux2, aux3) with c the cell-volume
-        normal (nor x bin).
+        Returns (ds, cR, cN, cB, m00, m11, m22): trapezoid arc weights, the
+        volume-normal (nor x bin) projected onto d(r,nor,bin)/ds, and the
+        elliptic-section moments w*H, w^3*H/4, w*H^3/4.
         """
         rs = self.rS
         ds = np.empty(self.Nm)
@@ -117,15 +118,21 @@ class FishMidlineData:
         dnds = _d_ds(rs, self.nor)
         dbds = _d_ds(rs, self.bin)
         w, H = self.width, self.height
-        aux1 = w * H * np.einsum("ij,ij->i", c, drds) * ds
-        aux2 = 0.25 * w**3 * H * np.einsum("ij,ij->i", c, dnds) * ds
-        aux3 = 0.25 * w * H**3 * np.einsum("ij,ij->i", c, dbds) * ds
-        return ds, c, aux1, aux2, aux3
+        m00 = w * H
+        m11 = 0.25 * w**3 * H
+        m22 = 0.25 * w * H**3
+        cR = np.einsum("ij,ij->i", c, drds)
+        cN = np.einsum("ij,ij->i", c, dnds)
+        cB = np.einsum("ij,ij->i", c, dbds)
+        return ds, cR, cN, cB, m00, m11, m22
 
     def integrate_linear_momentum(self) -> None:
         """Shift r and v so the deforming body has zero net volume-weighted
         position and linear momentum (main.cpp:10961-11012)."""
-        _, _, aux1, aux2, aux3 = self._section_integrals()
+        ds, cR, cN, cB, m00, m11, m22 = self._section_integrals()
+        aux1 = m00 * cR * ds
+        aux2 = m11 * cN * ds
+        aux3 = m22 * cB * ds
         vol = np.sum(aux1) * np.pi
         cm = (
             np.einsum("i,ij->j", aux1, self.r)
@@ -144,22 +151,7 @@ class FishMidlineData:
         """Solve J w = L for the deformation's angular velocity, rotate the
         whole midline by the accumulated internal quaternion, and add the
         -w x r counter-rotation to v (main.cpp:11013-11219)."""
-        rs = self.rS
-        ds = np.empty(self.Nm)
-        ds[0] = 0.5 * (rs[1] - rs[0])
-        ds[-1] = 0.5 * (rs[-1] - rs[-2])
-        ds[1:-1] = 0.5 * (rs[2:] - rs[:-2])
-        c = np.cross(self.nor, self.bin)
-        drds = _d_ds(rs, self.r)
-        dnds = _d_ds(rs, self.nor)
-        dbds = _d_ds(rs, self.bin)
-        w, H = self.width, self.height
-        m00 = w * H
-        m11 = 0.25 * w**3 * H
-        m22 = 0.25 * w * H**3
-        cR = np.einsum("ij,ij->i", c, drds)
-        cN = np.einsum("ij,ij->i", c, dnds)
-        cB = np.einsum("ij,ij->i", c, dbds)
+        ds, cR, cN, cB, m00, m11, m22 = self._section_integrals()
 
         def moment2(a, an, ab_, b, bn, bb):
             """sum over section of p_a q_b dV up to O(w^2,h^2) terms, for

@@ -220,7 +220,7 @@ def compute_widths_heights(height_name: str, width_name: str, length, rs):
             yh = np.array([0, 0.05, 0.14, 0.15, 0.11, 0, 0.1, 0.2, 0]) * L
             return bspline_profile(xh, yh, L, rs)
         if name.startswith("naca"):
-            return naca_width(int(name[5:]) * 0.01, L, rs)
+            return naca_width(int(name[4:]) * 0.01, L, rs)
         if name == "danio":
             return danio_height(L, rs)
         if name == "stefan":
@@ -238,7 +238,7 @@ def compute_widths_heights(height_name: str, width_name: str, length, rs):
             yw = np.array([0, 8.9e-2, 7.0e-2, 3.0e-2, 2.0e-2, 0]) * L
             return bspline_profile(xw, yw, L, rs)
         if name.startswith("naca"):
-            return naca_width(int(name[5:]) * 0.01, L, rs)
+            return naca_width(int(name[4:]) * 0.01, L, rs)
         if name == "danio":
             return danio_width(L, rs)
         if name == "stefan":

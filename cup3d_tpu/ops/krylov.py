@@ -1,0 +1,296 @@
+"""Matrix-free Krylov machinery: the TPU analogue of the reference's
+``PoissonSolverAMR`` (pipelined BiCGSTAB, main.cpp:14363-14616) and its
+per-block CG "getZ" preconditioner (poisson_kernels, main.cpp:14617-14746).
+
+Design notes (TPU-first, not a port):
+
+- The reference overlaps ``MPI_Iallreduce`` with preconditioner work to hide
+  reduction latency across ranks.  Under ``jit`` + SPMD sharding, XLA already
+  schedules the ``psum`` behind independent compute, so we use the *plain*
+  preconditioned BiCGSTAB recurrence — fewer fused reductions beat manual
+  pipelining on ICI (SURVEY.md section 7, hard part (c)).
+- The getZ preconditioner is kept, because its structure is ideal for TPU:
+  an independent fixed-iteration CG on every 8^3 tile, batched over the tile
+  axis — a dense, static-shape, embarrassingly parallel kernel.  The
+  reference iterates each block CG to a tolerance (<=100 its,
+  main.cpp:14739); we use a *fixed* iteration count so the compiled graph is
+  static and every tile takes the same time (no block-imbalance).
+- Breakdown handling: the reference restarts up to 100 times and keeps the
+  best-residual ``x_opt`` (main.cpp:14374, 14452).  We do the same inside
+  one ``lax.while_loop``: on rho/omega breakdown the recurrence re-seeds
+  ``rhat = r, p = v = 0``, and a running best-x is carried in the state.
+
+All reductions are ``jnp`` dots: under ``pjit`` they lower to ``psum`` over
+the device mesh, which is the ICI-native replacement for the reference's
+``MPI_Iallreduce``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.grid.uniform import UniformGrid
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _dot(a, b):
+    # accumulate in at least f32; keeps f64 accuracy for f64 solves
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    return jnp.sum(a * b, dtype=acc)
+
+
+def make_laplacian(grid: UniformGrid) -> Callable:
+    """Matrix-free 7-point Laplacian  (lap x)_i = (sum_nb x - 6 x_i)/h^2
+    with the grid's scalar BCs (periodic wrap / zero-gradient), the same
+    operator ``ComputeLHS`` applies (main.cpp:9197-9269, without the h^3
+    scaling — we keep physical 1/h^2 units so rhs is the physical rhs).
+    """
+    inv_h2 = 1.0 / (grid.h * grid.h)
+
+    def apply(x: jnp.ndarray) -> jnp.ndarray:
+        xp = grid.pad_scalar(x, 1)
+        c = xp[1:-1, 1:-1, 1:-1]
+        out = (
+            xp[2:, 1:-1, 1:-1]
+            + xp[:-2, 1:-1, 1:-1]
+            + xp[1:-1, 2:, 1:-1]
+            + xp[1:-1, :-2, 1:-1]
+            + xp[1:-1, 1:-1, 2:]
+            + xp[1:-1, 1:-1, :-2]
+            - 6.0 * c
+        )
+        return out * inv_h2
+
+    return apply
+
+
+# ---------------------------------------------------------------------------
+# getZ block preconditioner: fixed-iteration CG on every bs^3 tile
+# ---------------------------------------------------------------------------
+
+
+def _tile(x: jnp.ndarray, bs: int) -> jnp.ndarray:
+    """(nx,ny,nz) -> (NBx,NBy,NBz,bs,bs,bs) tile view."""
+    nx, ny, nz = x.shape
+    x = x.reshape(nx // bs, bs, ny // bs, bs, nz // bs, bs)
+    return x.transpose(0, 2, 4, 1, 3, 5)
+
+
+def _untile(t: jnp.ndarray) -> jnp.ndarray:
+    nbx, nby, nbz, bs, _, _ = t.shape
+    return t.transpose(0, 3, 1, 4, 2, 5).reshape(nbx * bs, nby * bs, nbz * bs)
+
+
+def _block_lap(t: jnp.ndarray) -> jnp.ndarray:
+    """Per-tile 7-pt Laplacian (h^2-scaled out) with implicit zero-Dirichlet
+    halo — exactly the preconditioner operator of kernelPoissonGetZInner
+    (main.cpp:14651-14702)."""
+    z = jnp.pad(t, [(0, 0)] * 3 + [(1, 1)] * 3)
+    c = z[..., 1:-1, 1:-1, 1:-1]
+    return (
+        z[..., 2:, 1:-1, 1:-1]
+        + z[..., :-2, 1:-1, 1:-1]
+        + z[..., 1:-1, 2:, 1:-1]
+        + z[..., 1:-1, :-2, 1:-1]
+        + z[..., 1:-1, 1:-1, 2:]
+        + z[..., 1:-1, 1:-1, :-2]
+        - 6.0 * c
+    )
+
+
+def make_block_cg_preconditioner(bs: int = 8, iters: int = 12,
+                                 h: float = 1.0) -> Callable:
+    """z ~ A^{-1} r block-locally for A = lap/h^2: `iters` CG steps on each
+    bs^3 tile, batched over tiles.  The tile operator is -block_lap (SPD
+    with the implicit zero-Dirichlet halo), so plain CG applies; the h^2
+    scaling of A is folded into the per-tile rhs so M is a genuine
+    approximate inverse of A (not just a Krylov-equivalent rescaling)."""
+    h2 = h * h
+
+    def precond(r: jnp.ndarray) -> jnp.ndarray:
+        rt = _tile(r, bs)
+        b = -h2 * rt  # solve (-lap) z = (-h^2 r): SPD system per tile
+        acc = jnp.promote_types(r.dtype, jnp.float32)
+        bdot = lambda a, c: jnp.sum(
+            a * c, axis=(-1, -2, -3), keepdims=True, dtype=acc
+        )
+
+        z0 = jnp.zeros_like(b)
+        res0 = b
+        p0 = b
+        rs0 = bdot(res0, res0)
+
+        def body(_, carry):
+            z, res, p, rs = carry
+            ap = -_block_lap(p)
+            denom = bdot(p, ap)
+            alpha = rs / jnp.where(jnp.abs(denom) > 1e-30, denom, 1.0)
+            alpha = jnp.where(jnp.abs(denom) > 1e-30, alpha, 0.0)
+            z = z + alpha * p
+            res = res - alpha * ap
+            rs_new = bdot(res, res)
+            beta = rs_new / jnp.where(rs > 1e-30, rs, 1.0)
+            beta = jnp.where(rs > 1e-30, beta, 0.0)
+            p = res + beta * p
+            return z, res, p, rs_new
+
+        z, _, _, _ = jax.lax.fori_loop(0, iters, body, (z0, res0, p0, rs0))
+        return _untile(z)
+
+    return precond
+
+
+# ---------------------------------------------------------------------------
+# restarted preconditioned BiCGSTAB
+# ---------------------------------------------------------------------------
+
+
+class _BiCGState(NamedTuple):
+    k: jnp.ndarray
+    x: jnp.ndarray
+    r: jnp.ndarray
+    rhat: jnp.ndarray
+    p: jnp.ndarray
+    v: jnp.ndarray
+    rho: jnp.ndarray
+    alpha: jnp.ndarray
+    omega: jnp.ndarray
+    rnorm: jnp.ndarray
+    x_best: jnp.ndarray
+    rnorm_best: jnp.ndarray
+
+
+def bicgstab(
+    apply_A: Callable,
+    b: jnp.ndarray,
+    M: Optional[Callable] = None,
+    x0: Optional[jnp.ndarray] = None,
+    tol_abs: float = 1e-6,
+    tol_rel: float = 1e-4,
+    maxiter: int = 1000,
+):
+    """Preconditioned BiCGSTAB with breakdown re-seeding and best-x tracking
+    (the reference's solve loop, main.cpp:14449-14604).  Returns
+    (x_best, final residual norm, iterations used).
+
+    Stopping matches the reference: ||r|| <= max(tol_abs, tol_rel*||r0||)
+    (PoissonErrorTol/PoissonErrorTolRel, main.cpp:15364-15365).
+    """
+    if M is None:
+        M = lambda r: r
+    if x0 is None:
+        x0 = jnp.zeros_like(b)
+
+    eps = jnp.asarray(1e-30, b.dtype)
+
+    r0 = b - apply_A(x0)
+    rnorm0 = jnp.sqrt(_dot(r0, r0))
+    target = jnp.maximum(tol_abs, tol_rel * rnorm0)
+    one = jnp.asarray(1.0, b.dtype)
+
+    init = _BiCGState(
+        k=jnp.asarray(0, jnp.int32),
+        x=x0,
+        r=r0,
+        rhat=r0,
+        p=jnp.zeros_like(b),
+        v=jnp.zeros_like(b),
+        rho=one,
+        alpha=one,
+        omega=one,
+        rnorm=rnorm0,
+        x_best=x0,
+        rnorm_best=rnorm0,
+    )
+
+    def cond(s: _BiCGState):
+        return jnp.logical_and(s.k < maxiter, s.rnorm > target)
+
+    def body(s: _BiCGState):
+        rho_new = _dot(s.rhat, s.r)
+        # rho breakdown -> re-seed shadow residual (reference restart,
+        # main.cpp:14452-14479)
+        broke = jnp.abs(rho_new) < eps * jnp.maximum(s.rnorm * s.rnorm, 1.0)
+        rhat = jnp.where(broke, s.r, s.rhat)
+        rho_new = jnp.where(broke, s.rnorm * s.rnorm, rho_new)
+        p_prev = jnp.where(broke, 0.0, s.p)
+        v_prev = jnp.where(broke, 0.0, s.v)
+
+        beta = (rho_new / _safe(s.rho)) * (s.alpha / _safe(s.omega))
+        beta = jnp.where(broke, 0.0, beta)
+        p = s.r + beta * (p_prev - s.omega * v_prev)
+        y = M(p)
+        v = apply_A(y)
+        rhat_v = _dot(rhat, v)
+        alpha = rho_new / _safe(rhat_v)
+        svec = s.r - alpha * v
+        z = M(svec)
+        t = apply_A(z)
+        tt = _dot(t, t)
+        omega = _dot(t, svec) / _safe(tt)
+        x = s.x + alpha * y + omega * z
+        r = svec - omega * t
+        rnorm = jnp.sqrt(_dot(r, r))
+
+        better = rnorm < s.rnorm_best
+        return _BiCGState(
+            k=s.k + 1,
+            x=x,
+            r=r,
+            rhat=rhat,
+            p=p,
+            v=v,
+            rho=rho_new,
+            alpha=alpha,
+            omega=omega,
+            rnorm=rnorm,
+            x_best=jnp.where(better, x, s.x_best),
+            rnorm_best=jnp.minimum(rnorm, s.rnorm_best),
+        )
+
+    out = jax.lax.while_loop(cond, body, init)
+    return out.x_best, out.rnorm_best, out.k
+
+
+def _safe(d):
+    return jnp.where(jnp.abs(d) > 1e-30, d, jnp.asarray(1e-30, d.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Poisson front-end (iterative; see poisson.build_spectral_solver for the
+# uniform-grid spectral fast path)
+# ---------------------------------------------------------------------------
+
+
+def build_iterative_solver(
+    grid: UniformGrid,
+    tol_abs: float = 1e-6,
+    tol_rel: float = 1e-4,
+    maxiter: int = 1000,
+    precond_bs: int = 8,
+    precond_iters: int = 12,
+) -> Callable:
+    """solve(rhs) -> p with mean(p)=0, via getZ-preconditioned BiCGSTAB.
+
+    The all-Neumann/periodic Laplacian is singular (constants); we project
+    the nullspace out of the rhs and the answer, the same role as the
+    reference's bMeanConstraint / global mean subtraction
+    (main.cpp:9273-9327, 15109-15134).
+    """
+    A = make_laplacian(grid)
+    M = make_block_cg_preconditioner(precond_bs, precond_iters, h=grid.h)
+
+    def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        b = rhs - jnp.mean(rhs)
+        x, _, _ = bicgstab(
+            A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter
+        )
+        return x - jnp.mean(x)
+
+    return solve

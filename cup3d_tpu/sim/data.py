@@ -17,7 +17,7 @@ import numpy as np
 from cup3d_tpu.config import SimulationConfig
 from cup3d_tpu.grid.uniform import BC, UniformGrid
 from cup3d_tpu.io.logging import BufferedLogger, Profiler
-from cup3d_tpu.ops.poisson import build_spectral_solver
+from cup3d_tpu.ops.poisson import make_poisson_solver
 
 
 class SimulationData:
@@ -35,7 +35,13 @@ class SimulationData:
             "udef": jnp.zeros(n3, self.dtype),
         }
 
-        self.poisson_solver: Callable = build_spectral_solver(self.grid, self.dtype)
+        self.poisson_solver: Callable = make_poisson_solver(
+            self.grid,
+            cfg.poissonSolver,
+            self.dtype,
+            tol_abs=cfg.poissonTol,
+            tol_rel=cfg.poissonTolRel,
+        )
 
         # scalars (host side, mirroring main.cpp:15348-15387 defaults)
         self.time: float = 0.0

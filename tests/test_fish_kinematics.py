@@ -110,7 +110,8 @@ def test_midline_momentum_removed():
     cf.integrate_linear_momentum()
     cf.integrate_angular_momentum(dt)
     # recompute the linear integrals: they must now vanish
-    _, _, aux1, aux2, aux3 = cf._section_integrals()
+    ds, cR, cN, cB, m00, m11, m22 = cf._section_integrals()
+    aux1, aux2, aux3 = m00 * cR * ds, m11 * cN * ds, m22 * cB * ds
     vol = np.sum(aux1)
     cm = (
         np.einsum("i,ij->j", aux1, cf.r)

@@ -1,0 +1,100 @@
+"""Iterative Poisson path: getZ-preconditioned BiCGSTAB (reference
+PoissonSolverAMR main.cpp:14363-14616 + poisson_kernels 14617-14746)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup3d_tpu.grid.uniform import BC, UniformGrid
+from cup3d_tpu.ops import krylov
+from cup3d_tpu.ops.poisson import build_spectral_solver
+
+
+def _grid(bc, n=32):
+    return UniformGrid((n, n, n), (1.0, 1.0, 1.0), (bc,) * 3)
+
+
+def test_block_precond_reduces_residual():
+    g = _grid(BC.periodic)
+    A = krylov.make_laplacian(g)
+    M = krylov.make_block_cg_preconditioner(bs=8, iters=12, h=g.h)
+    key = jax.random.PRNGKey(0)
+    r = jax.random.normal(key, g.shape, jnp.float32)
+    r = r - jnp.mean(r)
+    z = M(r)
+    # z should be a decent block-local inverse: residual of A z vs r drops
+    # compared to the trivial preconditioner z=r scaled optimally.
+    res_M = jnp.linalg.norm((A(z) - r).ravel()) / jnp.linalg.norm(r.ravel())
+    assert np.isfinite(float(res_M))
+    # the block solve is exact in the tile interior; the mismatch is only the
+    # zero-Dirichlet tile skin, so the relative residual must be well below 1
+    assert float(res_M) < 0.9
+
+
+@pytest.mark.parametrize("bc", [BC.periodic, BC.wall])
+def test_bicgstab_solves_discrete_poisson(bc):
+    g = _grid(bc)
+    A = krylov.make_laplacian(g)
+    x = np.asarray(g.cell_centers())
+    # manufactured pressure compatible with both wrap and zero-gradient BCs
+    p_true = (
+        np.cos(2 * np.pi * x[..., 0])
+        * np.cos(2 * np.pi * x[..., 1])
+        * np.cos(4 * np.pi * x[..., 2])
+    ).astype(np.float32)
+    p_true -= p_true.mean()
+    rhs = A(jnp.asarray(p_true))
+
+    solve = krylov.build_iterative_solver(g, tol_abs=1e-6, tol_rel=1e-5)
+    p = jax.jit(solve)(rhs)
+    err = np.linalg.norm(np.asarray(p) - p_true) / np.linalg.norm(p_true)
+    assert err < 2e-3, err
+
+
+def test_bicgstab_matches_spectral_on_periodic():
+    g = _grid(BC.periodic, n=16)
+    A = krylov.make_laplacian(g)
+    key = jax.random.PRNGKey(1)
+    rhs = jax.random.normal(key, g.shape, jnp.float32)
+    rhs = rhs - jnp.mean(rhs)
+
+    p_it = krylov.build_iterative_solver(g, tol_abs=1e-7, tol_rel=1e-6)(rhs)
+    p_sp = build_spectral_solver(g, operator="compact")(rhs)
+    err = np.linalg.norm(np.asarray(p_it - p_sp)) / np.linalg.norm(np.asarray(p_sp))
+    assert err < 1e-3, err
+
+
+def test_bicgstab_reports_iterations_and_converges_fast():
+    g = _grid(BC.periodic)
+    A = krylov.make_laplacian(g)
+    M = krylov.make_block_cg_preconditioner(8, 12, h=g.h)
+    key = jax.random.PRNGKey(2)
+    b = jax.random.normal(key, g.shape, jnp.float32)
+    b = b - jnp.mean(b)
+    x, rnorm, k = krylov.bicgstab(A, b, M=M, tol_abs=1e-6, tol_rel=1e-5)
+    b_norm = float(jnp.linalg.norm(b.ravel()))
+    assert float(rnorm) <= max(1e-6, 1e-5 * b_norm) * 1.01
+    # getZ preconditioning should converge far faster than the 1000-it cap
+    assert int(k) < 100
+
+
+def test_simulation_with_iterative_solver(tmp_path):
+    """End-to-end driver run on the Krylov path (poissonSolver=iterative)."""
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.sim.simulation import Simulation
+
+    cfg = SimulationConfig(
+        bpdx=4, bpdy=4, bpdz=4, levelMax=1, levelStart=0,
+        extent=2 * np.pi, CFL=0.3, nu=0.02, nsteps=3, rampup=0,
+        initCond="taylorGreen", poissonSolver="iterative", freqDiagnostics=1,
+        verbose=False, path4serialization=str(tmp_path),
+    )
+    s = Simulation(cfg)
+    s.init()
+    s.simulate()
+    div_last = [
+        float(v)
+        for v in (tmp_path / "div.txt").read_text().splitlines()[-1].split()
+    ]
+    assert div_last[3] < 5e-3  # max|div u| after iterative projection
