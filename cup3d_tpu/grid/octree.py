@@ -1,0 +1,189 @@
+"""Host-side octree-of-blocks topology (reference Grid/TreePosition/Info,
+main.cpp:320-427, 815-1080, and the 2:1 validation logic of
+MeshAdaptation::ValidStates, main.cpp:5330-5492).
+
+The tree is pure-Python/NumPy bookkeeping: a set of leaf keys
+``(level, i, j, k)`` over a base of ``bpd`` level-0 blocks per dimension.
+It never touches device memory — its products are *ordered leaf lists* and
+*owner lookups* that the gather-table builder (grid/blocks.py) consumes.
+
+Domain periodicity lives here (block-index wrapping); non-periodic faces
+return OUTSIDE from owner lookups and the table builder applies BC rules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cup3d_tpu.grid.sfc import global_order_key
+
+Key = Tuple[int, int, int, int]  # (level, i, j, k)
+
+OUTSIDE = (-1, -1, -1, -1)
+
+
+@dataclass(frozen=True)
+class TreeConfig:
+    bpd: Tuple[int, int, int]  # level-0 blocks per dimension
+    level_max: int  # number of levels (levels are 0..level_max-1)
+    periodic: Tuple[bool, bool, bool]
+
+
+class Octree:
+    """Mutable forest of octrees with 26-neighbor 2:1 balance."""
+
+    def __init__(self, cfg: TreeConfig, level_start: int = 0):
+        self.cfg = cfg
+        self.leaves: Dict[Key, None] = {}  # insertion-ordered set
+        if level_start >= cfg.level_max or level_start < 0:
+            raise ValueError(f"level_start {level_start} outside levels")
+        n = [b << level_start for b in cfg.bpd]
+        for i in range(n[0]):
+            for j in range(n[1]):
+                for k in range(n[2]):
+                    self.leaves[(level_start, i, j, k)] = None
+
+    # -- geometry helpers --------------------------------------------------
+
+    def blocks_per_dim(self, level: int) -> Tuple[int, int, int]:
+        return tuple(b << level for b in self.cfg.bpd)
+
+    def wrap(self, level: int, ijk) -> Optional[Tuple[int, int, int]]:
+        """Periodic wrap of block coords; None if outside a closed face."""
+        n = self.blocks_per_dim(level)
+        out = []
+        for a in range(3):
+            v = ijk[a]
+            if v < 0 or v >= n[a]:
+                if not self.cfg.periodic[a]:
+                    return None
+                v %= n[a]
+            out.append(v)
+        return tuple(out)
+
+    # -- ownership ---------------------------------------------------------
+
+    def is_leaf(self, key: Key) -> bool:
+        return key in self.leaves
+
+    def owner_of(self, level: int, ijk) -> Key:
+        """The leaf covering block position (level, ijk): the key itself, its
+        parent (coarser), or the key of the *finer* marker (level+1 children
+        exist).  Returns OUTSIDE past a closed boundary.  With 2:1 balance
+        the answer is always within one level (reference TreePosition
+        CheckFiner/CheckCoarser, main.cpp:320-330)."""
+        w = self.wrap(level, ijk)
+        if w is None:
+            return OUTSIDE
+        key = (level, *w)
+        if key in self.leaves:
+            return key
+        if level > 0:
+            parent = (level - 1, w[0] // 2, w[1] // 2, w[2] // 2)
+            if parent in self.leaves:
+                return parent
+        if level + 1 < self.cfg.level_max:
+            child0 = (level + 1, 2 * w[0], 2 * w[1], 2 * w[2])
+            if child0 in self.leaves:
+                return key  # covered by finer blocks; caller resolves children
+        raise KeyError(f"no owner for block {(level, *w)}: tree not 2:1 balanced?")
+
+    def owner_level(self, level: int, ijk) -> int:
+        """-2 outside, else the level of the covering leaf/leaves."""
+        w = self.wrap(level, ijk)
+        if w is None:
+            return -2
+        key = (level, *w)
+        if key in self.leaves:
+            return level
+        if level > 0 and (level - 1, w[0] // 2, w[1] // 2, w[2] // 2) in self.leaves:
+            return level - 1
+        if (
+            level + 1 < self.cfg.level_max
+            and (level + 1, 2 * w[0], 2 * w[1], 2 * w[2]) in self.leaves
+        ):
+            return level + 1
+        raise KeyError(f"no owner for block {(level, *w)}")
+
+    # -- ordering ----------------------------------------------------------
+
+    def ordered_leaves(self) -> List[Key]:
+        """Leaves sorted by the cross-level Hilbert key (the reference's
+        FillPos global ordering, main.cpp:1030-1060)."""
+        keys = list(self.leaves)
+        lv = np.array([k[0] for k in keys])
+        ijk = np.array([k[1:] for k in keys])
+        order = np.argsort(
+            global_order_key(lv, ijk, self.cfg.level_max, self.cfg.bpd),
+            kind="stable",
+        )
+        return [keys[int(o)] for o in order]
+
+    # -- topology surgery (used by MeshAdaptation) -------------------------
+
+    def refine(self, key: Key) -> List[Key]:
+        """Split a leaf into its 8 children (reference refine_1,
+        main.cpp:5227-5271)."""
+        level, i, j, k = key
+        if level + 1 >= self.cfg.level_max:
+            raise ValueError(f"cannot refine {key}: at level_max")
+        del self.leaves[key]
+        children = [
+            (level + 1, 2 * i + di, 2 * j + dj, 2 * k + dk)
+            for dk in (0, 1)
+            for dj in (0, 1)
+            for di in (0, 1)
+        ]
+        for c in children:
+            self.leaves[c] = None
+        return children
+
+    def compress(self, key: Key) -> Key:
+        """Merge the 8 siblings of `key` (any child of the octet) into the
+        parent (reference compress, main.cpp:5272-5328)."""
+        level, i, j, k = key
+        if level == 0:
+            raise ValueError("cannot compress level-0 block")
+        parent = (level - 1, i // 2, j // 2, k // 2)
+        for dk in (0, 1):
+            for dj in (0, 1):
+                for di in (0, 1):
+                    c = (level, 2 * parent[1] + di, 2 * parent[2] + dj,
+                         2 * parent[3] + dk)
+                    del self.leaves[c]
+        self.leaves[parent] = None
+        return parent
+
+    def siblings(self, key: Key) -> List[Key]:
+        level, i, j, k = key
+        p = (i // 2 * 2, j // 2 * 2, k // 2 * 2)
+        return [
+            (level, p[0] + di, p[1] + dj, p[2] + dk)
+            for dk in (0, 1)
+            for dj in (0, 1)
+            for di in (0, 1)
+        ]
+
+    def neighbor_levels(self, key: Key) -> List[int]:
+        """Owner levels of the 26 neighbors (-2 for outside)."""
+        level, i, j, k = key
+        out = []
+        for dk in (-1, 0, 1):
+            for dj in (-1, 0, 1):
+                for di in (-1, 0, 1):
+                    if di == dj == dk == 0:
+                        continue
+                    out.append(self.owner_level(level, (i + di, j + dj, k + dk)))
+        return out
+
+    def assert_balanced(self) -> None:
+        """26-neighbor 2:1 balance: every neighbor within one level."""
+        for key in self.leaves:
+            for nl in self.neighbor_levels(key):
+                if nl == -2:
+                    continue
+                if abs(nl - key[0]) > 1:
+                    raise AssertionError(f"2:1 violation at {key}: neighbor level {nl}")

@@ -1,0 +1,262 @@
+"""Differential operators on block-structured AMR fields.
+
+Mirrors the uniform-grid kernels (cup3d_tpu.ops.stencils) on
+``(nb, bs, bs, bs[, 3])`` block batches: halo'd labs come from the gather
+tables (grid/blocks.py), spatial derivatives are batch slices, and each
+block scales by its own spacing ``h``.  Conservative operators emit
+outward per-unit-area face fluxes for coarse-fine refluxing (grid/flux.py).
+
+Reference counterparts: KernelLHSPoisson (main.cpp:9197-9269),
+KernelAdvectDiffuse (9461-9639), KernelPressureRHS (14761-14948),
+KernelGradP (14957-15056), ComputeVorticity (8624-8745).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.grid.blocks import (
+    BlockGrid,
+    LabTables,
+    assemble_scalar_lab,
+    assemble_vector_lab,
+)
+from cup3d_tpu.grid.flux import FluxTables, apply_flux_correction
+
+
+def _sh(lab: jnp.ndarray, w: int, bs: int, ox=0, oy=0, oz=0) -> jnp.ndarray:
+    """Interior view of a (nb, L,L,L, ...) lab shifted by (ox,oy,oz)."""
+    return lab[
+        :,
+        w + ox : w + ox + bs,
+        w + oy : w + oy + bs,
+        w + oz : w + oz + bs,
+    ]
+
+
+def _off(axis, k):
+    o = [0, 0, 0]
+    o[axis] = k
+    return tuple(o)
+
+
+def _hcol(grid: BlockGrid, dtype=jnp.float32, extra: int = 0) -> jnp.ndarray:
+    """(nb, 1, 1, 1[, 1]) per-block spacing."""
+    shape = (grid.nb, 1, 1, 1) + (1,) * extra
+    return jnp.asarray(grid.h.reshape(shape), dtype)
+
+
+def face_fluxes(lab: jnp.ndarray, w: int, bs: int, inv_h: jnp.ndarray):
+    """Outward per-unit-area gradient fluxes (lab_nb - c)/h on the 6 faces:
+    (nb, 6, bs, bs) in the grid/flux.py convention."""
+    c = _sh(lab, w, bs)
+    ih = inv_h[:, 0, 0, 0][:, None, None]  # (nb,1,1)
+    fl = []
+    for ax in range(3):
+        lo = _sh(lab, w, bs, *_off(ax, -1))
+        hi = _sh(lab, w, bs, *_off(ax, 1))
+        sel_lo = [slice(None)] * 4
+        sel_lo[ax + 1] = 0
+        sel_hi = [slice(None)] * 4
+        sel_hi[ax + 1] = bs - 1
+        fl.append((lo - c)[tuple(sel_lo)] * ih)
+        fl.append((hi - c)[tuple(sel_hi)] * ih)
+    return jnp.stack(fl, axis=1)
+
+
+def laplacian_blocks(
+    grid: BlockGrid,
+    field: jnp.ndarray,
+    tab: LabTables,
+    flux_tab: Optional[FluxTables] = None,
+) -> jnp.ndarray:
+    """Refluxed 7-point Laplacian (the AMR ComputeLHS, main.cpp:9196-9328,
+    in physical 1/h^2 units)."""
+    bs = grid.bs
+    w = tab.width
+    lab = assemble_scalar_lab(field, tab, bs)
+    c = _sh(lab, w, bs)
+    s = -6.0 * c
+    for ax in range(3):
+        s = s + _sh(lab, w, bs, *_off(ax, 1)) + _sh(lab, w, bs, *_off(ax, -1))
+    inv_h = 1.0 / _hcol(grid, field.dtype)
+    out = s * inv_h * inv_h
+    if flux_tab is not None and flux_tab.ncorr:
+        fluxes = face_fluxes(lab, w, bs, inv_h)
+        out = apply_flux_correction(out, fluxes, flux_tab)
+    return out
+
+
+def grad_blocks(grid: BlockGrid, lab: jnp.ndarray, w: int) -> jnp.ndarray:
+    """(nb,bs,bs,bs,3) centered gradient from a scalar lab."""
+    bs = grid.bs
+    inv2h = 0.5 / _hcol(grid, lab.dtype)
+    return jnp.stack(
+        [
+            (_sh(lab, w, bs, *_off(a, 1)) - _sh(lab, w, bs, *_off(a, -1))) * inv2h
+            for a in range(3)
+        ],
+        axis=-1,
+    )
+
+
+def div_blocks(grid: BlockGrid, vlab: jnp.ndarray, w: int) -> jnp.ndarray:
+    """Centered divergence from a vector lab (nb, L,L,L, 3)."""
+    bs = grid.bs
+    inv2h = 0.5 / _hcol(grid, vlab.dtype)
+    out = 0.0
+    for a in range(3):
+        out = out + (
+            _sh(vlab[..., a], w, bs, *_off(a, 1))
+            - _sh(vlab[..., a], w, bs, *_off(a, -1))
+        )
+    return out * inv2h
+
+
+def curl_blocks(grid: BlockGrid, vlab: jnp.ndarray, w: int) -> jnp.ndarray:
+    bs = grid.bs
+    inv2h = 0.5 / _hcol(grid, vlab.dtype)
+
+    def d(c, a):
+        return (
+            _sh(vlab[..., c], w, bs, *_off(a, 1))
+            - _sh(vlab[..., c], w, bs, *_off(a, -1))
+        ) * inv2h
+
+    return jnp.stack(
+        [d(2, 1) - d(1, 2), d(0, 2) - d(2, 0), d(1, 0) - d(0, 1)], axis=-1
+    )
+
+
+# ---------------------------------------------------------------------------
+# advection-diffusion (explicit RK3) on blocks
+# ---------------------------------------------------------------------------
+
+_UP_W = 3  # 6-point biased upwind needs 3 ghosts
+
+
+def _upwind_d1(lab_c: jnp.ndarray, w: int, bs: int, axis: int, vel, inv_h):
+    """5th-order biased upwind derivative (KernelAdvectDiffuse,
+    main.cpp:9474-9483) on a batched lab component."""
+    q = [_sh(lab_c, w, bs, *_off(axis, k)) for k in range(-3, 4)]
+    inv60h = inv_h / 60.0
+    dplus = (
+        -2.0 * q[0] + 15.0 * q[1] - 60.0 * q[2] + 20.0 * q[3] + 30.0 * q[4]
+        - 3.0 * q[5]
+    ) * inv60h
+    dminus = (
+        2.0 * q[6] - 15.0 * q[5] + 60.0 * q[4] - 20.0 * q[3] - 30.0 * q[2]
+        + 3.0 * q[1]
+    ) * inv60h
+    return jnp.where(vel > 0, dplus, dminus)
+
+
+def advdiff_rhs_blocks(
+    grid: BlockGrid,
+    vel: jnp.ndarray,
+    tab: LabTables,
+    nu: float,
+    uinf: jnp.ndarray,
+    flux_tab: Optional[FluxTables] = None,
+) -> jnp.ndarray:
+    """du/dt = -(u+uinf).grad(u) + nu lap(u), refluxing diffusive fluxes
+    (reference AdvectionDiffusion, main.cpp:9640-9728)."""
+    bs = grid.bs
+    w = tab.width
+    vlab = assemble_vector_lab(vel, tab, bs)
+    inv_h = 1.0 / _hcol(grid, vel.dtype)
+    adv_u = _sh(vlab, w, bs) + uinf  # (nb,bs,bs,bs,3)
+
+    rhs = []
+    for c in range(3):
+        lab_c = vlab[..., c]
+        conv = 0.0
+        for a in range(3):
+            conv = conv + adv_u[..., a] * _upwind_d1(
+                lab_c, w, bs, a, adv_u[..., a], inv_h
+            )
+        s = -6.0 * _sh(lab_c, w, bs)
+        for a in range(3):
+            s = s + _sh(lab_c, w, bs, *_off(a, 1)) + _sh(lab_c, w, bs, *_off(a, -1))
+        diff = nu * s * inv_h * inv_h
+        out_c = diff - conv
+        if flux_tab is not None and flux_tab.ncorr:
+            fluxes = nu * face_fluxes(lab_c, w, bs, inv_h)
+            out_c = apply_flux_correction(out_c, fluxes, flux_tab)
+        rhs.append(out_c)
+    return jnp.stack(rhs, axis=-1)
+
+
+def rk3_step_blocks(
+    grid: BlockGrid,
+    vel: jnp.ndarray,
+    dt,
+    nu: float,
+    uinf: jnp.ndarray,
+    tab: LabTables,
+    flux_tab: Optional[FluxTables] = None,
+) -> jnp.ndarray:
+    """Low-storage RK3 (Williamson; the reference's AdvectionDiffusion
+    coefficients, main.cpp:9640-9655) — identical staging to the uniform
+    path (cup3d_tpu.ops.advection.rk3_step)."""
+    from cup3d_tpu.ops.advection import RK3_A, RK3_B
+
+    k = jnp.zeros_like(vel)
+    u = vel
+    for a, b in zip(RK3_A, RK3_B):
+        k = a * k + dt * advdiff_rhs_blocks(grid, u, tab, nu, uinf, flux_tab)
+        u = u + b * k
+    return u
+
+
+# ---------------------------------------------------------------------------
+# AMR Poisson front-end
+# ---------------------------------------------------------------------------
+
+
+def build_amr_poisson_solver(
+    grid: BlockGrid,
+    tol_abs: float = 1e-6,
+    tol_rel: float = 1e-4,
+    maxiter: int = 1000,
+    precond_iters: int = 12,
+):
+    """getZ-preconditioned BiCGSTAB on the AMR forest: the direct TPU
+    analogue of PoissonSolverAMR (main.cpp:14363-14616).  The nullspace of
+    the all-Neumann/periodic operator is removed with *volume-weighted*
+    means (blocks at different levels weigh h^3 differently)."""
+    from cup3d_tpu.grid.flux import build_flux_tables
+    from cup3d_tpu.ops import krylov
+
+    tab = grid.lab_tables(1)
+    flux_tab = build_flux_tables(grid)
+    vol = jnp.asarray(
+        (grid.h**3).reshape(grid.nb, 1, 1, 1), jnp.float32
+    )
+    vol_total = jnp.sum(vol) * grid.bs**3
+    h2 = jnp.asarray((grid.h**2).reshape(grid.nb, 1, 1, 1), jnp.float32)
+
+    def wmean(x):
+        return jnp.sum(x * vol) / vol_total
+
+    def A(x):
+        return laplacian_blocks(grid, x, tab, flux_tab)
+
+    def M(r):
+        # per-block CG with the block's own h^2 (poisson_kernels getZ,
+        # main.cpp:14617-14746); blocks are already bs^3 tiles
+        return krylov.block_cg_tiles(-h2 * r, precond_iters)
+
+    def solve(rhs, x0=None):
+        b = rhs - wmean(rhs)
+        x, rnorm, k = krylov.bicgstab(
+            A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter
+        )
+        return x - wmean(x)
+
+    return solve

@@ -91,7 +91,7 @@ def _block_lap(t: jnp.ndarray) -> jnp.ndarray:
     """Per-tile 7-pt Laplacian (h^2-scaled out) with implicit zero-Dirichlet
     halo — exactly the preconditioner operator of kernelPoissonGetZInner
     (main.cpp:14651-14702)."""
-    z = jnp.pad(t, [(0, 0)] * 3 + [(1, 1)] * 3)
+    z = jnp.pad(t, [(0, 0)] * (t.ndim - 3) + [(1, 1)] * 3)
     c = z[..., 1:-1, 1:-1, 1:-1]
     return (
         z[..., 2:, 1:-1, 1:-1]
@@ -104,43 +104,49 @@ def _block_lap(t: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def block_cg_tiles(b: jnp.ndarray, iters: int) -> jnp.ndarray:
+    """Solve (-block_lap) z = b independently on every trailing-bs^3 tile
+    of ``b`` (shape (..., bs, bs, bs)) with `iters` CG steps — the batched
+    getZ kernel (kernelPoissonGetZInner, main.cpp:14651-14702).  The tile
+    operator with its implicit zero-Dirichlet halo is SPD, so plain CG
+    applies; the fixed iteration count keeps the graph static and every
+    tile equally expensive (no block imbalance)."""
+    acc = jnp.promote_types(b.dtype, jnp.float32)
+    bdot = lambda a, c: jnp.sum(
+        a * c, axis=(-1, -2, -3), keepdims=True, dtype=acc
+    )
+
+    z0 = jnp.zeros_like(b)
+    rs0 = bdot(b, b)
+
+    def body(_, carry):
+        z, res, p, rs = carry
+        ap = -_block_lap(p)
+        denom = bdot(p, ap)
+        alpha = rs / jnp.where(jnp.abs(denom) > 1e-30, denom, 1.0)
+        alpha = jnp.where(jnp.abs(denom) > 1e-30, alpha, 0.0)
+        z = z + alpha * p
+        res = res - alpha * ap
+        rs_new = bdot(res, res)
+        beta = rs_new / jnp.where(rs > 1e-30, rs, 1.0)
+        beta = jnp.where(rs > 1e-30, beta, 0.0)
+        p = res + beta * p
+        return z, res, p, rs_new
+
+    z, _, _, _ = jax.lax.fori_loop(0, iters, body, (z0, b, b, rs0))
+    return z
+
+
 def make_block_cg_preconditioner(bs: int = 8, iters: int = 12,
                                  h: float = 1.0) -> Callable:
-    """z ~ A^{-1} r block-locally for A = lap/h^2: `iters` CG steps on each
-    bs^3 tile, batched over tiles.  The tile operator is -block_lap (SPD
-    with the implicit zero-Dirichlet halo), so plain CG applies; the h^2
-    scaling of A is folded into the per-tile rhs so M is a genuine
-    approximate inverse of A (not just a Krylov-equivalent rescaling)."""
+    """z ~ A^{-1} r block-locally for A = lap/h^2 on a *dense* grid:
+    tile the grid into bs^3 blocks and run block_cg_tiles.  The h^2 scaling
+    of A is folded into the per-tile rhs so M is a genuine approximate
+    inverse of A (not just a Krylov-equivalent rescaling)."""
     h2 = h * h
 
     def precond(r: jnp.ndarray) -> jnp.ndarray:
-        rt = _tile(r, bs)
-        b = -h2 * rt  # solve (-lap) z = (-h^2 r): SPD system per tile
-        acc = jnp.promote_types(r.dtype, jnp.float32)
-        bdot = lambda a, c: jnp.sum(
-            a * c, axis=(-1, -2, -3), keepdims=True, dtype=acc
-        )
-
-        z0 = jnp.zeros_like(b)
-        res0 = b
-        p0 = b
-        rs0 = bdot(res0, res0)
-
-        def body(_, carry):
-            z, res, p, rs = carry
-            ap = -_block_lap(p)
-            denom = bdot(p, ap)
-            alpha = rs / jnp.where(jnp.abs(denom) > 1e-30, denom, 1.0)
-            alpha = jnp.where(jnp.abs(denom) > 1e-30, alpha, 0.0)
-            z = z + alpha * p
-            res = res - alpha * ap
-            rs_new = bdot(res, res)
-            beta = rs_new / jnp.where(rs > 1e-30, rs, 1.0)
-            beta = jnp.where(rs > 1e-30, beta, 0.0)
-            p = res + beta * p
-            return z, res, p, rs_new
-
-        z, _, _, _ = jax.lax.fori_loop(0, iters, body, (z0, res0, p0, rs0))
+        z = block_cg_tiles(-h2 * _tile(r, bs), iters)
         return _untile(z)
 
     return precond
