@@ -165,27 +165,33 @@ class Obstacle:
         self.quaternion = quat_integrate(self.quaternion, self.angVel, dt)
 
 
-def momentum_integrals(grid: UniformGrid, chi: jnp.ndarray, vel: jnp.ndarray,
-                       cm_guess: jnp.ndarray):
-    """Jittable chi-weighted moments of the fluid velocity
-    (KernelIntegrateFluidMomenta, main.cpp:13625-13735):
-    mass, center, linear momentum, angular momentum and inertia about
-    cm_guess.  Returns a dict of device scalars/vectors."""
-    h3 = grid.h ** 3
-    x = grid.cell_centers(vel.dtype)
-    w = chi * h3
+def momentum_integrals_core(x: jnp.ndarray, vol, chi: jnp.ndarray,
+                            vel: jnp.ndarray, cm_guess: jnp.ndarray):
+    """Layout-generic chi-weighted moments (KernelIntegrateFluidMomenta,
+    main.cpp:13625-13735).  x: (..., 3) cell centers; vol: scalar or array
+    broadcastable to chi (per-cell volume); works for the dense uniform
+    layout and the (nb, bs, bs, bs) AMR block layout alike."""
+    w = (chi * vol).reshape(-1)
+    xf = x.reshape(-1, 3)
+    vf = vel.reshape(-1, 3)
     mass = jnp.sum(w)
-    center = jnp.einsum("xyz,xyzc->c", w, x)
-    lin = jnp.einsum("xyz,xyzc->c", w, vel)
-    r = x - cm_guess
-    ang = jnp.einsum("xyz,xyzc->c", w, jnp.cross(r, vel))
+    center = w @ xf
+    lin = w @ vf
+    r = xf - cm_guess
+    ang = w @ jnp.cross(r, vf)
     r2 = jnp.sum(r * r, axis=-1)
     eye = jnp.eye(3, dtype=vel.dtype)
-    inertia = jnp.einsum("xyz,xyz,ab->ab", w, r2, eye) - jnp.einsum(
-        "xyz,xyza,xyzb->ab", w, r, r
-    )
+    inertia = jnp.sum(w * r2) * eye - jnp.einsum("n,na,nb->ab", w, r, r)
     return {"mass": mass, "center": center, "lin_mom": lin, "ang_mom": ang,
             "inertia": inertia}
+
+
+def momentum_integrals(grid: UniformGrid, chi: jnp.ndarray, vel: jnp.ndarray,
+                       cm_guess: jnp.ndarray):
+    """Uniform-grid wrapper of momentum_integrals_core."""
+    return momentum_integrals_core(
+        grid.cell_centers(vel.dtype), grid.h ** 3, chi, vel, cm_guess
+    )
 
 
 def force_integrals(grid: UniformGrid, chi: jnp.ndarray, p: jnp.ndarray,

@@ -260,3 +260,94 @@ def build_amr_poisson_solver(
         return x - wmean(x)
 
     return solve
+
+
+# ---------------------------------------------------------------------------
+# pressure projection on blocks (reference PressureProjection,
+# main.cpp:15061-15160, kernels 14761-15056)
+# ---------------------------------------------------------------------------
+
+
+def div_fluxes(vlab: jnp.ndarray, w: int, bs: int) -> jnp.ndarray:
+    """Outward per-unit-area *velocity* fluxes of the centered divergence:
+    F(+a) = +(u_c + u_hi)/2 . e_a, F(-a) = -(u_c + u_lo)/2 . e_a, so that
+    div = (1/h) sum_f F — the flux form the reflux tables expect."""
+    fl = []
+    for ax in range(3):
+        u = vlab[..., ax]
+        c = _sh(u, w, bs)
+        lo = _sh(u, w, bs, *_off(ax, -1))
+        hi = _sh(u, w, bs, *_off(ax, 1))
+        sel_lo = [slice(None)] * 4
+        sel_lo[ax + 1] = 0
+        sel_hi = [slice(None)] * 4
+        sel_hi[ax + 1] = bs - 1
+        fl.append((-0.5 * (c + lo))[tuple(sel_lo)])
+        fl.append((0.5 * (c + hi))[tuple(sel_hi)])
+    return jnp.stack(fl, axis=1)
+
+
+def pressure_rhs_blocks(
+    grid: BlockGrid,
+    vel: jnp.ndarray,
+    dt,
+    tab: LabTables,
+    flux_tab: Optional[FluxTables] = None,
+    chi: Optional[jnp.ndarray] = None,
+    udef: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """rhs = div(u)/dt - chi div(u_def)/dt with conservative refluxing of
+    the velocity fluxes (KernelPressureRHS, main.cpp:14761-14948)."""
+    bs = grid.bs
+    w = tab.width
+    vlab = assemble_vector_lab(vel, tab, bs)
+    rhs = div_blocks(grid, vlab, w)
+    if flux_tab is not None and flux_tab.ncorr:
+        rhs = apply_flux_correction(rhs, div_fluxes(vlab, w, bs), flux_tab)
+    if chi is not None and udef is not None:
+        dlab = assemble_vector_lab(udef, tab, bs)
+        rhs = rhs - chi * div_blocks(grid, dlab, w)
+    return rhs / dt
+
+
+def project_blocks(
+    grid: BlockGrid,
+    vel: jnp.ndarray,
+    dt,
+    solver,
+    tab: LabTables,
+    flux_tab: Optional[FluxTables] = None,
+    chi: Optional[jnp.ndarray] = None,
+    udef: Optional[jnp.ndarray] = None,
+    p_init: Optional[jnp.ndarray] = None,
+):
+    """Solve lap p = rhs and correct u -= dt grad p.  Returns (u, p)."""
+    bs = grid.bs
+    rhs = pressure_rhs_blocks(grid, vel, dt, tab, flux_tab, chi, udef)
+    p = solver(rhs, p_init)
+    plab = assemble_scalar_lab(p, tab, bs)
+    gp = grad_blocks(grid, plab, tab.width)
+    return vel - dt * gp, p
+
+
+# ---------------------------------------------------------------------------
+# refinement scores (ComputeVorticity + GradChiOnTmp tagging,
+# main.cpp:8624-8745, 8540-8602)
+# ---------------------------------------------------------------------------
+
+
+def vorticity_score(grid: BlockGrid, vel: jnp.ndarray, tab: LabTables):
+    """(nb,) max |curl u| per block — the reference's tag magnitude."""
+    vlab = assemble_vector_lab(vel, tab, bs=grid.bs)
+    om = curl_blocks(grid, vlab, tab.width)
+    mag = jnp.sqrt(jnp.sum(om * om, axis=-1))
+    return jnp.max(mag.reshape(grid.nb, -1), axis=-1)
+
+
+def gradchi_mask(grid: BlockGrid, chi: jnp.ndarray, tab: LabTables):
+    """(nb,) bool: block touches the body interface (0 < chi < 1 anywhere
+    or grad chi != 0) -> force max refinement (GradChiOnTmp)."""
+    clab = assemble_scalar_lab(chi, tab, grid.bs)
+    g = grad_blocks(grid, clab, tab.width)
+    has_grad = jnp.max(jnp.sum(g * g, axis=-1).reshape(grid.nb, -1), axis=-1) > 0
+    return has_grad

@@ -1,0 +1,135 @@
+"""Mesh adaptation: tagging, 2:1 validation, refine/compress data
+transfer (reference MeshAdaptation, main.cpp:5023-5583)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.grid import adapt as ad
+from cup3d_tpu.grid.blocks import BlockGrid
+from cup3d_tpu.grid.octree import Octree, TreeConfig
+from cup3d_tpu.grid.uniform import BC
+
+BS = 8
+
+
+def _grid(level_max=3, bpd=2):
+    t = Octree(TreeConfig((bpd,) * 3, level_max, (True,) * 3), 0)
+    return BlockGrid(t, (float(bpd),) * 3, (BC.periodic,) * 3, bs=BS)
+
+
+def _linear(g: BlockGrid):
+    xc = g.cell_centers(np.float64)
+    return jnp.asarray(
+        (0.2 + 1.5 * xc[..., 0] - 0.5 * xc[..., 1] + 0.75 * xc[..., 2]).astype(
+            np.float32
+        )
+    )
+
+
+def test_refine_transfers_linear_exactly():
+    g = _grid(bpd=3)
+    f = _linear(g)
+    score = np.zeros(g.nb)
+    score[g.slot[(0, 1, 1, 1)]] = 10.0
+    states = ad.tag_states(g, score, rtol=1.0, ctol=0.1)
+    plan = ad.adapt(g, states)
+    assert plan is not None
+    ng = plan.new_grid
+    assert ng.nb == g.nb - 1 + 8
+    f2 = ad.transfer_field(g, plan, f)
+    expect = _linear(ng)
+    # exactness only for the refined (center) block's children + copies;
+    # seam blocks were plain copies anyway
+    np.testing.assert_allclose(np.asarray(f2), np.asarray(expect), atol=2e-5)
+
+
+def test_refine_compress_roundtrip_identity():
+    g = _grid(bpd=3)
+    f = _linear(g)
+    score = np.zeros(g.nb)
+    score[g.slot[(0, 1, 1, 1)]] = 10.0
+    plan = ad.adapt(g, ad.tag_states(g, score, 1.0, 0.1))
+    ng = plan.new_grid
+    f2 = ad.transfer_field(g, plan, f)
+    # now compress everything back
+    score2 = np.zeros(ng.nb)  # all below ctol
+    plan2 = ad.adapt(ng, ad.tag_states(ng, score2, 1.0, 0.1))
+    assert plan2 is not None
+    g3 = plan2.new_grid
+    assert g3.nb == g.nb and set(g3.keys) == set(g.keys)
+    f3 = ad.transfer_field(ng, plan2, f2)
+    # averaging undoes quadratic prolongation exactly for linears
+    perm = [g3.slot[k] for k in g.keys]
+    np.testing.assert_allclose(
+        np.asarray(f3)[perm], np.asarray(f), atol=2e-5
+    )
+
+
+def test_two_one_balance_forced_refinement():
+    """Refining a level-1 block next to level-0 leaves forces those
+    neighbors to refine (ValidStates rule, main.cpp:5330-5492)."""
+    g = _grid()
+    score = np.zeros(g.nb)
+    score[g.slot[(0, 0, 0, 0)]] = 10.0
+    plan = ad.adapt(g, ad.tag_states(g, score, 1.0, -1.0))
+    ng = plan.new_grid
+    # refine one of the new level-1 children at the far corner of the old
+    # block, adjacent to level-0 neighbors
+    score2 = np.zeros(ng.nb)
+    score2[ng.slot[(1, 1, 1, 1)]] = 10.0
+    plan2 = ad.adapt(ng, ad.tag_states(ng, score2, 1.0, -1.0))
+    g3 = plan2.new_grid
+    g3.tree.assert_balanced()
+    # the level-0 diagonal neighbor (0,1,1,1) must have been refined too
+    assert (0, 1, 1, 1) not in g3.tree.leaves
+
+
+def test_compression_vetoed_by_finer_neighbor():
+    g = _grid()
+    score = np.zeros(g.nb)
+    score[g.slot[(0, 0, 0, 0)]] = 10.0
+    plan = ad.adapt(g, ad.tag_states(g, score, 1.0, -1.0))
+    ng = plan.new_grid
+    # refine child (1,1,1,1) -> level 2; then try to compress everything
+    score2 = np.zeros(ng.nb)
+    score2[ng.slot[(1, 1, 1, 1)]] = 10.0
+    plan2 = ad.adapt(ng, ad.tag_states(ng, score2, 1.0, -1.0))
+    g3 = plan2.new_grid
+    # all level-1 siblings of the refined child want to compress, but the
+    # level-2 children forbid it
+    score3 = np.zeros(g3.nb)
+    plan3 = ad.adapt(g3, ad.tag_states(g3, score3, 1e9, 1.0))
+    if plan3 is not None:
+        g4 = plan3.new_grid
+        g4.tree.assert_balanced()
+        # the octet containing level-2 blocks must NOT have merged into
+        # a level-0 block while level-2 children exist
+        assert (0, 0, 0, 0) not in g4.tree.leaves
+
+
+def test_vector_transfer_preserves_linear():
+    g = _grid(bpd=3)
+    xc = g.cell_centers(np.float64)
+    v = np.stack(
+        [
+            0.3 + 0.9 * xc[..., 0],
+            -0.2 + 0.4 * xc[..., 1],
+            0.1 - 0.6 * xc[..., 2],
+        ],
+        axis=-1,
+    ).astype(np.float32)
+    score = np.zeros(g.nb)
+    score[g.slot[(0, 1, 1, 1)]] = 10.0
+    plan = ad.adapt(g, ad.tag_states(g, score, 1.0, 0.1))
+    ng = plan.new_grid
+    v2 = np.asarray(ad.transfer_field(g, plan, jnp.asarray(v)))
+    xc2 = ng.cell_centers(np.float64)
+    expect = np.stack(
+        [
+            0.3 + 0.9 * xc2[..., 0],
+            -0.2 + 0.4 * xc2[..., 1],
+            0.1 - 0.6 * xc2[..., 2],
+        ],
+        axis=-1,
+    ).astype(np.float32)
+    np.testing.assert_allclose(v2, expect, atol=2e-5)
