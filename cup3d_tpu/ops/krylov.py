@@ -104,13 +104,16 @@ def _block_lap(t: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def block_cg_tiles(b: jnp.ndarray, iters: int) -> jnp.ndarray:
-    """Solve (-block_lap) z = b independently on every trailing-bs^3 tile
-    of ``b`` (shape (..., bs, bs, bs)) with `iters` CG steps — the batched
-    getZ kernel (kernelPoissonGetZInner, main.cpp:14651-14702).  The tile
-    operator with its implicit zero-Dirichlet halo is SPD, so plain CG
+def block_cg_tiles(b: jnp.ndarray, iters: int, shift=0.0) -> jnp.ndarray:
+    """Solve (-block_lap + shift*I) z = b independently on every
+    trailing-bs^3 tile of ``b`` (shape (..., bs, bs, bs)) with `iters` CG
+    steps — the batched getZ kernel (kernelPoissonGetZInner,
+    main.cpp:14651-14702; the shifted variant is the diffusion getZ with
+    coefficient -6 - h^2/(nu dt), main.cpp:10571).  The tile operator with
+    its implicit zero-Dirichlet halo is SPD for shift >= 0, so plain CG
     applies; the fixed iteration count keeps the graph static and every
-    tile equally expensive (no block imbalance)."""
+    tile equally expensive (no block imbalance).  ``shift`` may be a
+    traced scalar or an array broadcastable to ``b`` (per-block h^2)."""
     acc = jnp.promote_types(b.dtype, jnp.float32)
     bdot = lambda a, c: jnp.sum(
         a * c, axis=(-1, -2, -3), keepdims=True, dtype=acc
@@ -121,7 +124,7 @@ def block_cg_tiles(b: jnp.ndarray, iters: int) -> jnp.ndarray:
 
     def body(_, carry):
         z, res, p, rs = carry
-        ap = -_block_lap(p)
+        ap = -_block_lap(p) + shift * p
         denom = bdot(p, ap)
         alpha = rs / jnp.where(jnp.abs(denom) > 1e-30, denom, 1.0)
         alpha = jnp.where(jnp.abs(denom) > 1e-30, alpha, 0.0)
