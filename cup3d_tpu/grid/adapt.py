@@ -119,14 +119,10 @@ def valid_states(tree: Octree, states: Dict[Key, str]) -> Dict[Key, str]:
                             nk = (l, *w)
                             if nk in [tuple(x) for x in sibs]:
                                 continue
-                            # finer neighbor, or same-level neighbor that
-                            # will refine, vetoes
-                            child = (
-                                (l + 1, 2 * w[0], 2 * w[1], 2 * w[2])
-                                if l + 1 < tree.cfg.level_max
-                                else None
-                            )
-                            if child is not None and child in tree.leaves:
+                            # finer coverage (at any depth — the reference's
+                            # CheckFiner, main.cpp:5381, is tree state), or a
+                            # same-level neighbor that will refine, vetoes
+                            if tree.covered_finer(nk):
                                 ok = False
                                 break
                             if nk in tree.leaves and st.get(nk) == "R":

@@ -115,14 +115,18 @@ class BlockGrid:
         self.h = (h0 / (1 << self.level.astype(np.int64))).astype(np.float64)
         self.origin = self.ijk * (self.h * bs)[:, None]
 
-        # dense (level, i, j, k) -> slot maps for vectorized owner lookups
+        # dense (level, i, j, k) -> slot maps for vectorized owner lookups,
+        # plus exact per-level internal-node masks ('covered finer')
         self._slot_maps: List[np.ndarray] = []
+        self._int_maps: List[np.ndarray] = []
         for l in range(cfg.level_max):
             n = tree.blocks_per_dim(l)
-            m = np.full(n, -1, np.int32)
-            self._slot_maps.append(m)
+            self._slot_maps.append(np.full(n, -1, np.int32))
+            self._int_maps.append(np.zeros(n, bool))
         for s, (l, i, j, k) in enumerate(self.keys):
             self._slot_maps[l][i, j, k] = s
+        for (l, i, j, k) in tree.internal_nodes():
+            self._int_maps[l][i, j, k] = True
 
         self._lab_cache: Dict[int, LabTables] = {}
 
@@ -178,9 +182,8 @@ class BlockGrid:
 
     def _owner_level_vec(self, l: int, bpos: np.ndarray) -> np.ndarray:
         """Vectorized owner level for block positions (..., 3) at level l.
-        Returns l-1, l, or l+1 (input must be in-domain).  Cells covered
-        two levels finer report l+1 (caller descends again)."""
-        lm = self.tree.cfg.level_max
+        Returns l-1, l, or l+1 (input must be in-domain).  Positions covered
+        finer at any depth report l+1 (caller descends again)."""
         sm = self._slot_maps
         i, j, k = bpos[..., 0], bpos[..., 1], bpos[..., 2]
         own = np.full(bpos.shape[:-1], -9, np.int32)
@@ -189,12 +192,9 @@ class BlockGrid:
         if l > 0:
             par = sm[l - 1][i // 2, j // 2, k // 2] >= 0
             own[~is_leaf & par] = l - 1
-        if l + 1 < lm:
-            fin = sm[l + 1][2 * i, 2 * j, 2 * k] >= 0
-            own[(own == -9) & fin] = l + 1
-        if l + 2 < lm:
-            fin2 = sm[l + 2][4 * i, 4 * j, 4 * k] >= 0
-            own[(own == -9) & fin2] = l + 1  # report finer; caller descends
+        # exact 'covered finer' membership (internal node at any depth)
+        fin = self._int_maps[l][i, j, k]
+        own[(own == -9) & fin] = l + 1
         if np.any(own == -9):
             raise KeyError("unresolved owner: tree not 2:1 balanced?")
         return own

@@ -57,10 +57,10 @@ def build_flux_tables(grid) -> FluxTables:
             w = tree.wrap(l, npos)
             if w is None:
                 continue
-            try:
-                own = tree.owner_level(l, w)
-            except KeyError:
-                continue
+            # no try/except: a KeyError from owner_level always means a
+            # broken tree, and silently skipping a coarse-fine face would
+            # silently lose conservation
+            own = tree.owner_level(l, w)
             if own != l + 1:
                 continue
             # fine neighbor blocks: children of region w at level l+1 whose

@@ -32,12 +32,64 @@ class TreeConfig:
     periodic: Tuple[bool, bool, bool]
 
 
+class _LeafDict(dict):
+    """Insertion-ordered leaf set that version-stamps every mutation so the
+    derived ancestor set can be rebuilt lazily (callers — adapt.py, tests —
+    mutate ``tree.leaves`` directly)."""
+
+    __slots__ = ("version",)
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.version = 0
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        self.version += 1
+
+    def __delitem__(self, k):
+        super().__delitem__(k)
+        self.version += 1
+
+    def clear(self):
+        super().clear()
+        self.version += 1
+
+    def pop(self, *a):
+        self.version += 1
+        return super().pop(*a)
+
+    def update(self, *a, **kw):
+        super().update(*a, **kw)
+        self.version += 1
+
+    def setdefault(self, *a):
+        self.version += 1
+        return super().setdefault(*a)
+
+    def popitem(self):
+        self.version += 1
+        return super().popitem()
+
+    def __ior__(self, other):
+        self.version += 1
+        return super().__ior__(other)
+
+
 class Octree:
-    """Mutable forest of octrees with 26-neighbor 2:1 balance."""
+    """Mutable forest of octrees with 26-neighbor 2:1 balance.
+
+    'Covered finer' queries are answered by exact membership in the set of
+    *internal nodes* (strict ancestors of leaves) — the analogue of the
+    reference's tree-state CheckFiner (main.cpp:320-330), which is tree
+    state, not a corner-child probe.
+    """
 
     def __init__(self, cfg: TreeConfig, level_start: int = 0):
         self.cfg = cfg
-        self.leaves: Dict[Key, None] = {}  # insertion-ordered set
+        self.leaves: Dict[Key, None] = _LeafDict()  # insertion-ordered set
+        self._anc_version = -1
+        self._anc_set: set = set()
         if level_start >= cfg.level_max or level_start < 0:
             raise ValueError(f"level_start {level_start} outside levels")
         n = [b << level_start for b in cfg.bpd]
@@ -45,6 +97,31 @@ class Octree:
             for j in range(n[1]):
                 for k in range(n[2]):
                     self.leaves[(level_start, i, j, k)] = None
+
+    # -- internal-node (ancestor) set --------------------------------------
+
+    def _ancestors(self) -> set:
+        """Set of strict ancestors of all leaves, rebuilt on demand."""
+        if self._anc_version != self.leaves.version:
+            anc: set = set()
+            for (l, i, j, k) in self.leaves:
+                while l > 0:
+                    l, i, j, k = l - 1, i >> 1, j >> 1, k >> 1
+                    key = (l, i, j, k)
+                    if key in anc:
+                        break
+                    anc.add(key)
+            self._anc_set = anc
+            self._anc_version = self.leaves.version
+        return self._anc_set
+
+    def covered_finer(self, key: Key) -> bool:
+        """True iff the block position is covered by strictly finer leaves
+        (i.e. is an internal node of the tree)."""
+        return key in self._ancestors()
+
+    def internal_nodes(self) -> Iterable[Key]:
+        return self._ancestors()
 
     # -- geometry helpers --------------------------------------------------
 
@@ -71,9 +148,9 @@ class Octree:
 
     def owner_of(self, level: int, ijk) -> Key:
         """The leaf covering block position (level, ijk): the key itself, its
-        parent (coarser), or the key of the *finer* marker (level+1 children
-        exist).  Returns OUTSIDE past a closed boundary.  With 2:1 balance
-        the answer is always within one level (reference TreePosition
+        parent (coarser), or the key of the *finer* marker (the position is an
+        internal node).  Returns OUTSIDE past a closed boundary.  With 2:1
+        balance the answer is always within one level (reference TreePosition
         CheckFiner/CheckCoarser, main.cpp:320-330)."""
         w = self.wrap(level, ijk)
         if w is None:
@@ -85,14 +162,13 @@ class Octree:
             parent = (level - 1, w[0] // 2, w[1] // 2, w[2] // 2)
             if parent in self.leaves:
                 return parent
-        if level + 1 < self.cfg.level_max:
-            child0 = (level + 1, 2 * w[0], 2 * w[1], 2 * w[2])
-            if child0 in self.leaves:
-                return key  # covered by finer blocks; caller resolves children
+        if self.covered_finer(key):
+            return key  # covered by finer blocks; caller resolves children
         raise KeyError(f"no owner for block {(level, *w)}: tree not 2:1 balanced?")
 
     def owner_level(self, level: int, ijk) -> int:
-        """-2 outside, else the level of the covering leaf/leaves."""
+        """-2 outside; level+1 if the position is covered by finer leaves
+        (at any depth — the caller descends); else the covering leaf level."""
         w = self.wrap(level, ijk)
         if w is None:
             return -2
@@ -101,10 +177,7 @@ class Octree:
             return level
         if level > 0 and (level - 1, w[0] // 2, w[1] // 2, w[2] // 2) in self.leaves:
             return level - 1
-        if (
-            level + 1 < self.cfg.level_max
-            and (level + 1, 2 * w[0], 2 * w[1], 2 * w[2]) in self.leaves
-        ):
+        if self.covered_finer(key):
             return level + 1
         raise KeyError(f"no owner for block {(level, *w)}")
 
@@ -167,23 +240,43 @@ class Octree:
             for di in (0, 1)
         ]
 
-    def neighbor_levels(self, key: Key) -> List[int]:
-        """Owner levels of the 26 neighbors (-2 for outside)."""
-        level, i, j, k = key
-        out = []
-        for dk in (-1, 0, 1):
-            for dj in (-1, 0, 1):
-                for di in (-1, 0, 1):
-                    if di == dj == dk == 0:
-                        continue
-                    out.append(self.owner_level(level, (i + di, j + dj, k + dk)))
-        return out
-
     def assert_balanced(self) -> None:
-        """26-neighbor 2:1 balance: every neighbor within one level."""
+        """26-neighbor 2:1 balance.  A neighbor region covered finer is only
+        legal if every sub-block *touching this leaf* is a leaf at level+1 —
+        a touching sub-block that is itself internal means level+2 cells
+        adjoin a level-`level` leaf."""
+        anc = self._ancestors()
         for key in self.leaves:
-            for nl in self.neighbor_levels(key):
-                if nl == -2:
-                    continue
-                if abs(nl - key[0]) > 1:
-                    raise AssertionError(f"2:1 violation at {key}: neighbor level {nl}")
+            level, i, j, k = key
+            for dk in (-1, 0, 1):
+                for dj in (-1, 0, 1):
+                    for di in (-1, 0, 1):
+                        if di == dj == dk == 0:
+                            continue
+                        w = self.wrap(level, (i + di, j + dj, k + dk))
+                        if w is None:
+                            continue
+                        nk = (level, *w)
+                        if nk in self.leaves:
+                            continue
+                        if level > 0 and (
+                            level - 1, w[0] // 2, w[1] // 2, w[2] // 2
+                        ) in self.leaves:
+                            continue
+                        if nk not in anc:
+                            raise AssertionError(f"broken tree at {key}: "
+                                                 f"neighbor {nk} uncovered")
+                        # children of nk facing back at this leaf
+                        for oi in ((1,) if di < 0 else (0,) if di > 0 else (0, 1)):
+                            for oj in ((1,) if dj < 0 else (0,) if dj > 0 else (0, 1)):
+                                for ok in ((1,) if dk < 0 else (0,) if dk > 0 else (0, 1)):
+                                    c = (level + 1, 2 * w[0] + oi,
+                                         2 * w[1] + oj, 2 * w[2] + ok)
+                                    if c in anc:
+                                        raise AssertionError(
+                                            f"2:1 violation at {key}: touching "
+                                            f"neighbor child {c} covered finer")
+                                    if c not in self.leaves:
+                                        raise AssertionError(
+                                            f"broken tree at {key}: child {c} "
+                                            f"of {nk} missing")

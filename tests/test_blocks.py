@@ -70,6 +70,47 @@ def test_octree_owner_lookup():
     t.assert_balanced()
 
 
+def test_owner_lookup_deep_refinement():
+    """Covered-finer classification must be exact tree state, not a
+    corner-child probe: a balanced tree whose corner child is itself
+    refined used to raise KeyError from owner_level/assert_balanced
+    (ADVICE round-1 repro)."""
+    t = _tree(bpd=(2, 2, 2), level_max=4, periodic=(False,) * 3)
+    for key in [(0, 0, 0, 0), (0, 1, 0, 0), (1, 0, 0, 0), (1, 1, 0, 0),
+                (2, 2, 0, 0)]:
+        t.refine(key)
+    t.assert_balanced()  # balanced (non-periodic: deep leaves sit at a wall)
+    # the level-0 position (0,0,0) is covered finer even though its corner
+    # child (1,0,0,0) is internal, not a leaf
+    assert t.owner_level(0, (0, 0, 0)) == 1
+    assert t.covered_finer((0, 0, 0, 0))
+    assert t.covered_finer((1, 0, 0, 0))
+    assert not t.covered_finer((2, 4, 0, 0))
+    # vectorized owner lookup + lab/flux table construction must succeed
+    g = _grid(t, bc=(BC.wall,) * 3)
+    for w in (1, 2):
+        g.lab_tables(w)
+    from cup3d_tpu.grid.flux import build_flux_tables
+
+    build_flux_tables(g)
+    # under periodic wrap the same refinement IS unbalanced: level-2 leaves
+    # touch the level-0 column through the z-boundary
+    tp = _tree(bpd=(2, 2, 2), level_max=4)
+    for key in [(0, 0, 0, 0), (0, 1, 0, 0), (1, 0, 0, 0), (1, 1, 0, 0),
+                (2, 2, 0, 0)]:
+        tp.refine(key)
+    with pytest.raises(AssertionError):
+        tp.assert_balanced()
+
+
+def test_assert_balanced_catches_violation():
+    t = _tree(bpd=(2, 2, 2), level_max=3)
+    t.refine((0, 0, 0, 0))
+    t.refine((1, 0, 0, 0))  # level-2 leaves now touch level-0 neighbors
+    with pytest.raises(AssertionError):
+        t.assert_balanced()
+
+
 def test_ordered_leaves_locality():
     t = _tree()
     t.refine((0, 0, 0, 0))
