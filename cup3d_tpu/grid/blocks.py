@@ -132,6 +132,12 @@ class BlockGrid:
 
     # -- geometry ----------------------------------------------------------
 
+    @property
+    def hmin(self) -> float:
+        """Spacing at the deepest allowed level (reference hmin,
+        main.cpp:15402) — the resolution bodies are rasterized at."""
+        return self.h0 / (1 << (self.tree.cfg.level_max - 1))
+
     def cell_centers(self, dtype=np.float32) -> np.ndarray:
         """(nb, bs, bs, bs, 3) physical cell-center coordinates."""
         bs = self.bs

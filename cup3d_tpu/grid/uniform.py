@@ -50,6 +50,12 @@ class UniformGrid:
         return self.extent[0] / self.shape[0]
 
     @property
+    def hmin(self) -> float:
+        """Finest spacing (= h on a single-level grid); the layout-generic
+        resolution query shared with BlockGrid."""
+        return self.h
+
+    @property
     def spacing(self) -> Tuple[float, float, float]:
         return tuple(e / n for e, n in zip(self.extent, self.shape))
 

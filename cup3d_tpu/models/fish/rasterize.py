@@ -72,45 +72,33 @@ def _segment_distance(p, seg):
     return d_signed, udef
 
 
-@partial(jax.jit, static_argnames=("window_shape",))
-def rasterize_midline(
-    origin,
-    h,
-    window_shape,
-    midline,
-    position,
-    rot,
-):
-    """Rasterize a midline tube over a dense window.
+@jax.jit
+def rasterize_points(points, midline, position, rot):
+    """Rasterize a midline tube at arbitrary cell centers.
+
+    The layout-generic core shared by the dense uniform window and the
+    per-candidate-block AMR path (the TPU analogue of the reference's
+    per-block PutFishOnBlocks, main.cpp:10718-10951).
 
     Args:
-      origin: (3,) physical coordinate of the window corner (device).
-      h: cell spacing (python float or scalar).
-      window_shape: static (nx, ny, nz) of the window.
+      points: (..., 3) computational-frame cell-center coordinates.
       midline: dict of device arrays r, v, nor, vnor, bin, vbin (Nm, 3)
         and width, height (Nm,) -- body frame.
       position: (3,) body position in the computational frame.
       rot: (3, 3) body->computational rotation matrix.
 
-    Returns (sdf, udef): (nx,ny,nz) with sdf > 0 inside, and (nx,ny,nz,3)
-    deformation velocity in the computational frame.
+    Returns (sdf, udef): points.shape[:-1] with sdf > 0 inside, and
+    (..., 3) deformation velocity in the computational frame.
     """
-    nx, ny, nz = window_shape
     dtype = midline["r"].dtype
-    ii = jnp.arange(nx, dtype=dtype)
-    jj = jnp.arange(ny, dtype=dtype)
-    kk = jnp.arange(nz, dtype=dtype)
-    X = origin[0] + (ii[:, None, None] + 0.5) * h
-    Y = origin[1] + (jj[None, :, None] + 0.5) * h
-    Z = origin[2] + (kk[None, None, :] + 0.5) * h
-    p_comp = jnp.stack(jnp.broadcast_arrays(X, Y, Z), axis=-1)
     # body frame: x_body = R^T (x_comp - position)
-    p = jnp.einsum("...c,cd->...d", p_comp - position, rot)
+    p = jnp.einsum("...c,cd->...d", points - position, rot)
+    shape = p.shape[:-1]
 
     nm = midline["r"].shape[0]
     big = jnp.asarray(1e10, dtype)
-    d0 = jnp.full(window_shape, big)
-    u0 = jnp.zeros(window_shape + (3,), dtype)
+    d0 = jnp.full(shape, big)
+    u0 = jnp.zeros(shape + (3,), dtype)
 
     def body(ss, carry):
         dmin, udef = carry
@@ -132,3 +120,35 @@ def rasterize_midline(
     sdf = -dmin  # reference convention: positive inside
     udef_comp = jnp.einsum("...c,dc->...d", udef_body, rot)
     return sdf, udef_comp
+
+
+@partial(jax.jit, static_argnames=("window_shape",))
+def rasterize_midline(
+    origin,
+    h,
+    window_shape,
+    midline,
+    position,
+    rot,
+):
+    """Rasterize a midline tube over a dense uniform window.
+
+    Args:
+      origin: (3,) physical coordinate of the window corner (device).
+      h: cell spacing (python float or scalar).
+      window_shape: static (nx, ny, nz) of the window.
+      midline / position / rot: see rasterize_points.
+
+    Returns (sdf, udef): (nx,ny,nz) with sdf > 0 inside, and (nx,ny,nz,3)
+    deformation velocity in the computational frame.
+    """
+    nx, ny, nz = window_shape
+    dtype = midline["r"].dtype
+    ii = jnp.arange(nx, dtype=dtype)
+    jj = jnp.arange(ny, dtype=dtype)
+    kk = jnp.arange(nz, dtype=dtype)
+    X = origin[0] + (ii[:, None, None] + 0.5) * h
+    Y = origin[1] + (jj[None, :, None] + 0.5) * h
+    Z = origin[2] + (kk[None, None, :] + 0.5) * h
+    p_comp = jnp.stack(jnp.broadcast_arrays(X, Y, Z), axis=-1)
+    return rasterize_points(p_comp, midline, position, rot)

@@ -24,7 +24,7 @@ import numpy as np
 
 from cup3d_tpu.models.base import Obstacle, quat_to_rot
 from cup3d_tpu.models.fish.curvature import CurvatureDefinedFishData
-from cup3d_tpu.models.fish.rasterize import rasterize_midline
+from cup3d_tpu.models.fish.rasterize import rasterize_midline, rasterize_points
 from cup3d_tpu.models.fish.shapes import compute_widths_heights
 from cup3d_tpu.ops.chi import heaviside
 
@@ -62,7 +62,9 @@ class StefanFish(Obstacle):
                 ) and abs(self.quaternion[0] - 1) > 1e-6:
             raise ValueError("PID controllers require zero initial angles")
 
-        h = sim.grid.h
+        # midline resolution follows the finest spacing the grid can offer
+        # (reference: sim.hmin, main.cpp:15402); layout-generic
+        h = sim.grid.hmin
         self.myFish = CurvatureDefinedFishData(
             self.length, self.Tperiod, self.phaseShift, h, amp
         )
@@ -72,10 +74,13 @@ class StefanFish(Obstacle):
         self.origC = self.position.copy()  # PID target (spawn point)
         self.r_axis: deque = deque()  # roll-axis history for bCorrectRoll
 
-        # static rasterization window: the deformed fish stays within ~0.6 L
-        # of its center; margin for the mollified band
-        nw = int(np.ceil(1.25 * self.length / h)) + 8
-        self._window_shape = tuple(min(nw, n) for n in sim.grid.shape)
+        # dense uniform layout: a static rasterization window (the deformed
+        # fish stays within ~0.6 L of its center; margin for the mollified
+        # band).  Block layout: candidate blocks are found per call.
+        self._is_blocks = not hasattr(sim.grid, "shape")
+        if not self._is_blocks:
+            nw = int(np.ceil(1.25 * self.length / h)) + 8
+            self._window_shape = tuple(min(nw, n) for n in sim.grid.shape)
         self._win_origin = np.zeros(3)
 
     # -- geometry pipeline (Fish::create, main.cpp:10952-10958) ------------
@@ -140,7 +145,61 @@ class StefanFish(Obstacle):
                 gmax, dgdtmax, dt_eff, gg, dgdt, cf.gamma, cf.dgamma
             )
 
+    def _midline_device(self):
+        cf = self.myFish
+        dtype = self.sim.dtype
+        return {
+            "r": jnp.asarray(cf.r, dtype), "v": jnp.asarray(cf.v, dtype),
+            "nor": jnp.asarray(cf.nor, dtype), "vnor": jnp.asarray(cf.vnor, dtype),
+            "bin": jnp.asarray(cf.bin, dtype), "vbin": jnp.asarray(cf.vbin, dtype),
+            "width": jnp.asarray(cf.width, dtype),
+            "height": jnp.asarray(cf.height, dtype),
+        }
+
+    def _rasterize_blocks(self, t: float):
+        """Block-layout rasterization: candidate blocks by AABB intersection
+        (the TPU analogue of prepare_segPerBlock, main.cpp:10672-10717),
+        one batched midline-distance evaluation over their cells, scattered
+        into the (nb, bs, bs, bs) forest arrays."""
+        grid = self.sim.grid
+        dtype = self.sim.dtype
+        bs = grid.bs
+        # fish AABB around the body center, padded per block by the
+        # mollification band at that block's spacing
+        half = 0.625 * self.length + 8.0 * grid.h  # (nb,)
+        lo = grid.origin  # (nb, 3)
+        hi = grid.origin + (bs * grid.h)[:, None]
+        cand = np.all(hi > self.position - half[:, None], axis=1) & np.all(
+            lo < self.position + half[:, None], axis=1
+        )
+        idx = np.where(cand)[0]
+        m = len(idx)
+        # bucket the candidate count so XLA retraces only on bucket changes
+        mpad = max(16, -(-m // 16) * 16)
+        idx_pad = np.full(mpad, grid.nb, np.int64)  # OOB rows -> dropped
+        idx_pad[:m] = idx
+        bsr = np.arange(bs) + 0.5
+        loc = np.stack(np.meshgrid(bsr, bsr, bsr, indexing="ij"), axis=-1)
+        centers = np.full((mpad, bs, bs, bs, 3), 1e6, np.float64)
+        centers[:m] = (
+            grid.origin[idx][:, None, None, None, :]
+            + loc[None] * grid.h[idx][:, None, None, None, None]
+        )
+        sdf_c, udef_c = rasterize_points(
+            jnp.asarray(centers, dtype), self._midline_device(),
+            jnp.asarray(self.position, dtype),
+            jnp.asarray(quat_to_rot(self.quaternion), dtype),
+        )
+        scat = jnp.asarray(idx_pad, jnp.int32)
+        sdf = jnp.full((grid.nb, bs, bs, bs), -1.0, dtype)
+        sdf = sdf.at[scat].set(sdf_c, mode="drop")
+        udef = jnp.zeros((grid.nb, bs, bs, bs, 3), dtype)
+        udef = udef.at[scat].set(udef_c, mode="drop")
+        return sdf, udef
+
     def rasterize(self, t: float):
+        if self._is_blocks:
+            return self._rasterize_blocks(t)
         cf = self.myFish
         grid = self.sim.grid
         h = grid.h
@@ -151,17 +210,11 @@ class StefanFish(Obstacle):
         idx0 = np.clip(idx0, 0, np.asarray(grid.shape) - self._window_shape)
         self._win_idx0 = idx0
         self._win_origin = idx0 * h
-        midline = {
-            "r": jnp.asarray(cf.r, dtype), "v": jnp.asarray(cf.v, dtype),
-            "nor": jnp.asarray(cf.nor, dtype), "vnor": jnp.asarray(cf.vnor, dtype),
-            "bin": jnp.asarray(cf.bin, dtype), "vbin": jnp.asarray(cf.vbin, dtype),
-            "width": jnp.asarray(cf.width, dtype),
-            "height": jnp.asarray(cf.height, dtype),
-        }
         rot = quat_to_rot(self.quaternion)
         sdf_w, udef_w = rasterize_midline(
             jnp.asarray(self._win_origin, dtype), h, self._window_shape,
-            midline, jnp.asarray(self.position, dtype), jnp.asarray(rot, dtype),
+            self._midline_device(), jnp.asarray(self.position, dtype),
+            jnp.asarray(rot, dtype),
         )
         sdf = jnp.full(grid.shape, -1.0, dtype)
         sdf = jax.lax.dynamic_update_slice(sdf, sdf_w, tuple(idx0))
@@ -297,15 +350,46 @@ class StefanFish(Obstacle):
         """
         s = self.sim
         grid = s.grid
-        h = grid.h
-        idx = np.clip(
-            np.floor(np.asarray(pos) / h - 0.5).astype(int), 1,
-            np.asarray(grid.shape) - 3,
-        )
-        patch_v = jax.lax.dynamic_slice(
-            s.state["vel"], tuple(idx - 1) + (0,), (4, 4, 4, 3)
-        )
-        patch_c = jax.lax.dynamic_slice(s.state["chi"], tuple(idx - 1), (4, 4, 4))
+        pos = np.asarray(pos, np.float64)
+        if self._is_blocks:
+            # holding leaf, finest level first (holdingBlockID,
+            # main.cpp:15933-15981); sample the 4^3 patch inside the block,
+            # clamped to its interior (sensors sit on the body surface whose
+            # blocks are at the finest level, so the clamp is <= 1 cell)
+            bs = grid.bs
+            slot = -1
+            for l in range(grid.tree.cfg.level_max - 1, -1, -1):
+                hl = grid.h0 / (1 << l)
+                bpos = np.floor(pos / (bs * hl)).astype(int)
+                n = grid.tree.blocks_per_dim(l)
+                if np.any(bpos < 0) or np.any(bpos >= np.asarray(n)):
+                    continue
+                sl = grid._slot_maps[l][tuple(bpos)]
+                if sl >= 0:
+                    slot, h = int(sl), hl
+                    bcell0 = bpos * bs
+                    break
+            if slot < 0:
+                return np.zeros(3)
+            gidx = np.floor(pos / h - 0.5).astype(int)
+            lidx = np.clip(gidx - bcell0, 1, bs - 3)
+            idx = bcell0 + lidx
+            patch_v = jax.lax.dynamic_slice(
+                s.state["vel"][slot], tuple(lidx - 1) + (0,), (4, 4, 4, 3)
+            )
+            patch_c = jax.lax.dynamic_slice(
+                s.state["chi"][slot], tuple(lidx - 1), (4, 4, 4)
+            )
+        else:
+            h = grid.h
+            idx = np.clip(
+                np.floor(pos / h - 0.5).astype(int), 1,
+                np.asarray(grid.shape) - 3,
+            )
+            patch_v = jax.lax.dynamic_slice(
+                s.state["vel"], tuple(idx - 1) + (0,), (4, 4, 4, 3)
+            )
+            patch_c = jax.lax.dynamic_slice(s.state["chi"], tuple(idx - 1), (4, 4, 4))
         pv = np.asarray(patch_v, np.float64)
         pc = np.asarray(patch_c, np.float64)
         # centered gradients on the 2x2x2 interior of the patch

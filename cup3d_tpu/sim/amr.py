@@ -46,6 +46,12 @@ _EPS = 1e-6
 
 class AMRSimulation:
     def __init__(self, cfg: SimulationConfig):
+        if cfg.bFixMassFlux:
+            raise NotImplementedError(
+                "bFixMassFlux is only implemented on the uniform driver "
+                "(sim/operators.py FixMassFlux); the AMR profile-rescale "
+                "variant (main.cpp:12199-12249) is not wired yet"
+            )
         self.cfg = cfg
         self.dtype = jnp.dtype(cfg.dtype)
         periodic = tuple(b == "periodic" for b in cfg.bc)
@@ -74,6 +80,11 @@ class AMRSimulation:
     @property
     def sim(self):  # pragma: no cover - convenience alias
         return self
+
+    @property
+    def step(self) -> int:
+        """SimulationData-compatible step counter (obstacle PID etc.)."""
+        return self.step_idx
 
     def _alloc_fields(self):
         g = self.grid
@@ -110,11 +121,37 @@ class AMRSimulation:
             )
         )
         self._project = jax.jit(
-            lambda vel, dt, chi, udef: amr_ops.project_blocks(
-                g, vel, dt, self._solver, self._tab1, self._ftab, chi, udef
+            lambda vel, dt, chi, udef, p_old: amr_ops.project_blocks(
+                g, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
+                p_init=p_old,
+            )
+        )
+        self._project_2nd = jax.jit(
+            lambda vel, dt, chi, udef, p_old: amr_ops.project_blocks(
+                g, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
+                p_init=p_old, second_order=True,
             )
         )
         self._penalize = jax.jit(penalize)
+        self._forces = jax.jit(
+            lambda chi, p, vel, cm, ubody: amr_ops.force_integrals_blocks(
+                g, self._tab1, self._xc, chi, p, vel, self.nu, cm, ubody
+            )
+        )
+        # per-obstacle rigid+deformation velocity field from the cached
+        # device cell centers (avoids Obstacle.body_velocity_field's host
+        # rebuild of cell_centers every step)
+        self._ubody = jax.jit(
+            lambda udef, cm, ut, om: ut
+            + jnp.cross(jnp.broadcast_to(om, self._xc.shape), self._xc - cm)
+            + udef
+        )
+        self._divnorms = jax.jit(
+            lambda vel: amr_ops.divergence_norms_blocks(g, vel, self._tab1)
+        )
+        self._dissipation = jax.jit(
+            lambda vel: amr_ops.dissipation_blocks(g, vel, self.nu, self._tab1)
+        )
 
         def scores(vel, chi):
             vort = amr_ops.vorticity_score(g, vel, self._tab1)
@@ -166,10 +203,18 @@ class AMRSimulation:
         den = jnp.maximum(jnp.sum(stack, axis=0), _EPS)[..., None]
         self.state["udef"] = sum(c[..., None] * u for c, u in zip(chis, udefs)) / den
 
+    def _obstacle_ubody(self, ob):
+        return self._ubody(
+            ob.udef,
+            jnp.asarray(ob.centerOfMass, self.dtype),
+            jnp.asarray(ob.transVel, self.dtype),
+            jnp.asarray(ob.angVel, self.dtype),
+        )
+
     def _body_velocity(self):
         chis = jnp.stack([ob.chi for ob in self.obstacles])
         num = sum(
-            ob.chi[..., None] * ob.body_velocity_field() for ob in self.obstacles
+            ob.chi[..., None] * self._obstacle_ubody(ob) for ob in self.obstacles
         )
         den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
         return num / den
@@ -274,10 +319,64 @@ class AMRSimulation:
                     s["vel"], s["chi"], self._body_velocity(),
                     jnp.asarray(self.lambda_penal, self.dtype), dt_j,
                 )
+        if self.cfg.uMax_forced > 0 and not self.cfg.bFixMassFlux:
+            # constant streamwise acceleration (ExternalForcing,
+            # main.cpp:10581-10596)
+            H = self.grid.extent[1]
+            accel = 8.0 * self.nu * self.cfg.uMax_forced / (H * H)
+            s["vel"] = s["vel"].at[..., 0].add(accel * dt)
         with self.profiler("PressureProjection"):
-            s["vel"], s["p"] = self._project(s["vel"], dt_j, s["chi"], s["udef"])
+            # warm-start the Krylov solve from the previous pressure; after
+            # step_2nd_start use the reference's increment form
+            # (main.cpp:15087-15100)
+            proj = (
+                self._project_2nd
+                if self.step_idx >= self.cfg.step_2nd_start
+                else self._project
+            )
+            s["vel"], s["p"] = proj(s["vel"], dt_j, s["chi"], s["udef"], s["p"])
+        if self.obstacles:
+            with self.profiler("ComputeForces"):
+                self._compute_forces()
+        freq = self.cfg.freqDiagnostics
+        if freq > 0 and self.step_idx % freq == 0:
+            with self.profiler("Diagnostics"):
+                total, peak = self._divnorms(s["vel"])
+                self.logger.write(
+                    "div.txt",
+                    f"{self.step_idx} {self.time:.8e} {float(total):.8e}"
+                    f" {float(peak):.8e}\n",
+                )
+                d = self._dissipation(s["vel"])
+                self.logger.write(
+                    "energy.txt",
+                    f"{self.time:.8e} {float(d['kinetic_energy']):.8e} "
+                    f"{float(d['enstrophy']):.8e}"
+                    f" {float(d['dissipation_rate']):.8e}\n",
+                )
         self.step_idx += 1
         self.time += dt
+
+    def _compute_forces(self):
+        """Per-obstacle force/torque/power QoI (reference ComputeForces,
+        main.cpp:12496-12503, reduction 13079-13115)."""
+        s = self.state
+        for i, ob in enumerate(self.obstacles):
+            f = self._forces(
+                ob.chi, s["p"], s["vel"],
+                jnp.asarray(ob.centerOfMass, self.dtype),
+                self._obstacle_ubody(ob),
+            )
+            ob.pres_force = np.asarray(f["pres_force"], np.float64)
+            ob.visc_force = np.asarray(f["visc_force"], np.float64)
+            ob.force = ob.pres_force + ob.visc_force
+            ob.torque = np.asarray(f["torque"], np.float64)
+            ob.pow_out = float(f["power"])
+            self.logger.write(
+                f"forces_{i}.txt",
+                f"{self.time:.8e} " + " ".join(f"{v:.8e}" for v in ob.force)
+                + f" {ob.pow_out:.8e}\n",
+            )
 
     def simulate(self):
         cfg = self.cfg
