@@ -82,7 +82,7 @@ class Obstacle:
         # planar (yaw) spawn angle in degrees about +z (reference parses
         # planarAngle alongside the explicit quaternion, main.cpp:12820-12837)
         ang = np.deg2rad(g("planarAngle", 0.0))
-        if ang != 0.0 and abs(self.quaternion[0] - 1.0) < 1e-12:
+        if ang != 0.0 and np.allclose(self.quaternion, [1.0, 0.0, 0.0, 0.0]):
             self.quaternion = np.array([np.cos(ang / 2), 0.0, 0.0, np.sin(ang / 2)])
         self.transVel = np.array([g("xvel", 0.0), g("yvel", 0.0), g("zvel", 0.0)])
         self.angVel = np.zeros(3)

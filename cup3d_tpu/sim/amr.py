@@ -115,11 +115,23 @@ class AMRSimulation:
         self._vol = self._h_col**3
         self._xc = jnp.asarray(g.cell_centers(self.dtype))
 
-        self._advdiff = jax.jit(
-            lambda vel, dt, uinf: amr_ops.rk3_step_blocks(
-                g, vel, dt, self.nu, uinf, self._tab3, self._ftab
+        if cfg.implicitDiffusion:
+            from cup3d_tpu.ops import diffusion as dif
+
+            helm = dif.build_amr_helmholtz_solver(
+                g, tol_abs=cfg.diffusionTol, tol_rel=cfg.diffusionTolRel
             )
-        )
+            self._advdiff = jax.jit(
+                lambda vel, dt, uinf: dif.implicit_step_blocks(
+                    g, vel, dt, self.nu, uinf, self._tab3, helm
+                )
+            )
+        else:
+            self._advdiff = jax.jit(
+                lambda vel, dt, uinf: amr_ops.rk3_step_blocks(
+                    g, vel, dt, self.nu, uinf, self._tab3, self._ftab
+                )
+            )
         self._project = jax.jit(
             lambda vel, dt, chi, udef, p_old: amr_ops.project_blocks(
                 g, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
@@ -204,12 +216,21 @@ class AMRSimulation:
         self.state["udef"] = sum(c[..., None] * u for c, u in zip(chis, udefs)) / den
 
     def _obstacle_ubody(self, ob):
-        return self._ubody(
+        # cached per (step, rigid state); penalization and the force pass
+        # both consume the same field each step
+        tag = (self.step_idx, tuple(ob.transVel), tuple(ob.angVel),
+               tuple(ob.centerOfMass))
+        cached = getattr(ob, "_ubody_cache", None)
+        if cached is not None and cached[0] == tag:
+            return cached[1]
+        field = self._ubody(
             ob.udef,
             jnp.asarray(ob.centerOfMass, self.dtype),
             jnp.asarray(ob.transVel, self.dtype),
             jnp.asarray(ob.angVel, self.dtype),
         )
+        ob._ubody_cache = (tag, field)
+        return field
 
     def _body_velocity(self):
         chis = jnp.stack([ob.chi for ob in self.obstacles])
@@ -283,7 +304,17 @@ class AMRSimulation:
             if self.step_idx < cfg.rampup:
                 cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - self.step_idx / cfg.rampup))
             dt_adv = cfl * hmin / max(umax, 1e-12)
-            dt_dif = 0.25 * hmin * hmin / self.nu
+            if cfg.implicitDiffusion:
+                # keep the explicit cap while no velocity scale exists (see
+                # sim/simulation.py calc_max_timestep)
+                umax_eff = max(
+                    umax, cfg.uMax_forced, float(np.abs(self.uinf).max())
+                )
+                dt_dif = (
+                    np.inf if umax_eff > 1e-8 else 0.25 * hmin * hmin / self.nu
+                )
+            else:
+                dt_dif = 0.25 * hmin * hmin / self.nu
             self.dt = float(min(dt_adv, dt_dif))
             if cfg.tend > 0:
                 self.dt = min(self.dt, cfg.tend - self.time)
@@ -319,7 +350,7 @@ class AMRSimulation:
                     s["vel"], s["chi"], self._body_velocity(),
                     jnp.asarray(self.lambda_penal, self.dtype), dt_j,
                 )
-        if self.cfg.uMax_forced > 0 and not self.cfg.bFixMassFlux:
+        if self.cfg.uMax_forced > 0:  # bFixMassFlux rejected in __init__
             # constant streamwise acceleration (ExternalForcing,
             # main.cpp:10581-10596)
             H = self.grid.extent[1]

@@ -47,6 +47,27 @@ class AdvectionDiffusion(Operator):
         s.state["vel"] = self._step(s.state["vel"], dt=dt, uinf=s.uinf_device())
 
 
+class AdvectionDiffusionImplicit(Operator):
+    """Explicit-advection Euler + implicit diffusion solve
+    (main.cpp:9849-10118).  On the uniform grid the Helmholtz system
+    (I - nu dt lap) u = u* is diagonalized exactly per component
+    (ops/diffusion.py), so the step is unconditionally stable with no
+    Krylov iteration at all."""
+
+    def __init__(self, sim: SimulationData):
+        super().__init__(sim)
+        from cup3d_tpu.ops import diffusion as dif
+
+        helm = dif.build_spectral_helmholtz(sim.grid, sim.dtype)
+        self._step = jax.jit(
+            partial(dif.implicit_step, sim.grid, nu=sim.nu, helmholtz=helm)
+        )
+
+    def __call__(self, dt):
+        s = self.sim
+        s.state["vel"] = self._step(s.state["vel"], dt=dt, uinf=s.uinf_device())
+
+
 class ExternalForcing(Operator):
     """Constant streamwise acceleration for forced channel-type flows:
     du = 8 nu uMax / H^2 * dt (main.cpp:10581-10596)."""

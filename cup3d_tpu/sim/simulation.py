@@ -45,7 +45,10 @@ class Simulation:
         pipe: List[ops.Operator] = []
         if with_bodies:
             pipe.append(body_ops.CreateObstacles(s))
-        pipe.append(ops.AdvectionDiffusion(s))
+        if cfg.implicitDiffusion:
+            pipe.append(ops.AdvectionDiffusionImplicit(s))
+        else:
+            pipe.append(ops.AdvectionDiffusion(s))
         if cfg.uMax_forced > 0 and not cfg.bFixMassFlux:
             pipe.append(ops.ExternalForcing(s))
         if cfg.bFixMassFlux:
@@ -86,7 +89,13 @@ class Simulation:
             if s.step < cfg.rampup:  # logarithmic ramp 1e-2*CFL -> CFL
                 cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - s.step / cfg.rampup))
             dt_adv = cfl * h / max(umax, 1e-12)
-            dt_dif = 0.25 * h * h / s.nu if not cfg.implicitDiffusion else np.inf
+            if cfg.implicitDiffusion:
+                # a from-rest flow is diffusion-dominated: keep the explicit
+                # cap until any velocity scale exists, else dt_adv blows up
+                umax_eff = max(umax, cfg.uMax_forced, float(np.abs(s.uinf).max()))
+                dt_dif = np.inf if umax_eff > 1e-8 else 0.25 * h * h / s.nu
+            else:
+                dt_dif = 0.25 * h * h / s.nu
             s.dt = float(min(dt_adv, dt_dif))
             if cfg.tend > 0:
                 s.dt = min(s.dt, cfg.tend - s.time)
