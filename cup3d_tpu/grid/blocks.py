@@ -63,7 +63,12 @@ _WQ = {
 
 @dataclass
 class LabTables:
-    """Device-side gather tables for one (topology, width) pair."""
+    """Device-side gather tables for one (topology, width) pair.
+
+    The ``assemble_scalar`` / ``assemble_vector`` methods are the halo
+    protocol every AMR operator goes through; the multi-device forest
+    (parallel/forest.py) provides a duck-typed sharded implementation so
+    the operators in ops/amr_ops.py run unchanged on either."""
 
     width: int
     ghost_xyz: Tuple[np.ndarray, np.ndarray, np.ndarray]  # static (ng,) coords
@@ -76,6 +81,19 @@ class LabTables:
     s_sign: jnp.ndarray  # (nb, ns, 3) f32
     interp_w: jnp.ndarray  # (L, S) f32 separable quadratic upsample matrix
     any_coarse: bool  # whether any block has a coarser neighbor
+
+    def assemble_scalar(self, field: jnp.ndarray, bs: int) -> jnp.ndarray:
+        return assemble_scalar_lab(field, self, bs)
+
+    def assemble_vector(self, field: jnp.ndarray, bs: int) -> jnp.ndarray:
+        return assemble_vector_lab(field, self, bs)
+
+    def assemble_component(
+        self, field: jnp.ndarray, bs: int, comp: int
+    ) -> jnp.ndarray:
+        """One velocity component with its BC sign ghosts (BlockLabBC
+        per-direction labs, main.cpp:6851-6862)."""
+        return _assemble_vec_comp(field, self, bs, comp)
 
 
 class BlockGrid:
@@ -157,7 +175,10 @@ class BlockGrid:
 
     def lab_tables(self, width: int) -> LabTables:
         if width not in self._lab_cache:
-            self._lab_cache[width] = self._build_lab_tables(width)
+            # table constants must stay concrete even if a caller builds a
+            # solver under an active jit trace (cached tracers would leak)
+            with jax.ensure_compile_time_eval():
+                self._lab_cache[width] = self._build_lab_tables(width)
         return self._lab_cache[width]
 
     def _cells_per_dim(self, l: int) -> np.ndarray:

@@ -30,13 +30,19 @@ _FACE_SIDE = (-1, 1, -1, 1, -1, 1)  # low/high
 
 @dataclass
 class FluxTables:
-    """Precomputed coarse-side correction (empty tables are valid)."""
+    """Precomputed coarse-side correction (empty tables are valid).
+
+    ``apply`` is the protocol the AMR operators use; the sharded forest
+    (parallel/forest.py) duck-types it with a cross-shard exchange."""
 
     tgt_cell: jnp.ndarray  # (nc,) flat index into (nb*bs^3) cell array
     tgt_flux: jnp.ndarray  # (nc,) flat index into (nb*6*bs^2) flux array
     src_flux: jnp.ndarray  # (nc, 4) fine-side flux indices
     inv_hc: jnp.ndarray  # (nc,) 1/h of the corrected (coarse) block
     ncorr: int
+
+    def apply(self, out: jnp.ndarray, fluxes: jnp.ndarray) -> jnp.ndarray:
+        return apply_flux_correction(out, fluxes, self)
 
 
 def build_flux_tables(grid) -> FluxTables:

@@ -20,13 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cup3d_tpu.grid.blocks import (
-    BlockGrid,
-    LabTables,
-    assemble_scalar_lab,
-    assemble_vector_lab,
-)
-from cup3d_tpu.grid.flux import FluxTables, apply_flux_correction
+from cup3d_tpu.grid.blocks import BlockGrid, LabTables
+from cup3d_tpu.grid.flux import FluxTables
 
 
 def _sh(lab: jnp.ndarray, w: int, bs: int, ox=0, oy=0, oz=0) -> jnp.ndarray:
@@ -79,7 +74,7 @@ def laplacian_blocks(
     in physical 1/h^2 units)."""
     bs = grid.bs
     w = tab.width
-    lab = assemble_scalar_lab(field, tab, bs)
+    lab = tab.assemble_scalar(field, bs)
     c = _sh(lab, w, bs)
     s = -6.0 * c
     for ax in range(3):
@@ -88,7 +83,7 @@ def laplacian_blocks(
     out = s * inv_h * inv_h
     if flux_tab is not None and flux_tab.ncorr:
         fluxes = face_fluxes(lab, w, bs, inv_h)
-        out = apply_flux_correction(out, fluxes, flux_tab)
+        out = flux_tab.apply(out, fluxes)
     return out
 
 
@@ -168,7 +163,7 @@ def advdiff_rhs_blocks(
     (reference AdvectionDiffusion, main.cpp:9640-9728)."""
     bs = grid.bs
     w = tab.width
-    vlab = assemble_vector_lab(vel, tab, bs)
+    vlab = tab.assemble_vector(vel, bs)
     inv_h = 1.0 / _hcol(grid, vel.dtype)
     adv_u = _sh(vlab, w, bs) + uinf  # (nb,bs,bs,bs,3)
 
@@ -187,7 +182,7 @@ def advdiff_rhs_blocks(
         out_c = diff - conv
         if flux_tab is not None and flux_tab.ncorr:
             fluxes = nu * face_fluxes(lab_c, w, bs, inv_h)
-            out_c = apply_flux_correction(out_c, fluxes, flux_tab)
+            out_c = flux_tab.apply(out_c, fluxes)
         rhs.append(out_c)
     return jnp.stack(rhs, axis=-1)
 
@@ -225,19 +220,32 @@ def build_amr_poisson_solver(
     tol_rel: float = 1e-4,
     maxiter: int = 1000,
     precond_iters: int = 12,
+    tab: Optional[LabTables] = None,
+    flux_tab: Optional[FluxTables] = None,
+    vol: Optional[jnp.ndarray] = None,
+    pmask: Optional[jnp.ndarray] = None,
 ):
     """getZ-preconditioned BiCGSTAB on the AMR forest: the direct TPU
     analogue of PoissonSolverAMR (main.cpp:14363-14616).  The nullspace of
     the all-Neumann/periodic operator is removed with *volume-weighted*
-    means (blocks at different levels weigh h^3 differently)."""
+    means (blocks at different levels weigh h^3 differently).
+
+    ``tab``/``flux_tab`` may be pre-built (or the sharded forest's
+    duck-typed equivalents); ``vol`` overrides the per-block cell volume
+    (the forest passes zeros on padding blocks) and ``pmask`` zeroes
+    padding blocks after the mean shifts so they never re-enter the
+    Krylov iteration."""
     from cup3d_tpu.grid.flux import build_flux_tables
     from cup3d_tpu.ops import krylov
 
-    tab = grid.lab_tables(1)
-    flux_tab = build_flux_tables(grid)
-    vol = jnp.asarray(
-        (grid.h**3).reshape(grid.nb, 1, 1, 1), jnp.float32
-    )
+    if tab is None:
+        tab = grid.lab_tables(1)
+    if flux_tab is None:
+        flux_tab = build_flux_tables(grid)
+    if vol is None:
+        vol = jnp.asarray(
+            (grid.h**3).reshape(grid.nb, 1, 1, 1), jnp.float32
+        )
     vol_total = jnp.sum(vol) * grid.bs**3
     h2 = jnp.asarray((grid.h**2).reshape(grid.nb, 1, 1, 1), jnp.float32)
 
@@ -254,10 +262,13 @@ def build_amr_poisson_solver(
 
     def solve(rhs, x0=None):
         b = rhs - wmean(rhs)
+        if pmask is not None:
+            b = b * pmask
         x, rnorm, k = krylov.bicgstab(
             A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter
         )
-        return x - wmean(x)
+        x = x - wmean(x)
+        return x * pmask if pmask is not None else x
 
     return solve
 
@@ -300,12 +311,12 @@ def pressure_rhs_blocks(
     the velocity fluxes (KernelPressureRHS, main.cpp:14761-14948)."""
     bs = grid.bs
     w = tab.width
-    vlab = assemble_vector_lab(vel, tab, bs)
+    vlab = tab.assemble_vector(vel, bs)
     rhs = div_blocks(grid, vlab, w)
     if flux_tab is not None and flux_tab.ncorr:
-        rhs = apply_flux_correction(rhs, div_fluxes(vlab, w, bs), flux_tab)
+        rhs = flux_tab.apply(rhs, div_fluxes(vlab, w, bs))
     if chi is not None and udef is not None:
-        dlab = assemble_vector_lab(udef, tab, bs)
+        dlab = tab.assemble_vector(udef, bs)
         rhs = rhs - chi * div_blocks(grid, dlab, w)
     return rhs / dt
 
@@ -337,7 +348,7 @@ def project_blocks(
         p = p_init + solver(rhs, None)
     else:
         p = solver(rhs, p_init)
-    plab = assemble_scalar_lab(p, tab, bs)
+    plab = tab.assemble_scalar(p, bs)
     gp = grad_blocks(grid, plab, tab.width)
     return vel - dt * gp, p
 
@@ -350,7 +361,7 @@ def project_blocks(
 
 def vorticity_score(grid: BlockGrid, vel: jnp.ndarray, tab: LabTables):
     """(nb,) max |curl u| per block — the reference's tag magnitude."""
-    vlab = assemble_vector_lab(vel, tab, bs=grid.bs)
+    vlab = tab.assemble_vector(vel, grid.bs)
     om = curl_blocks(grid, vlab, tab.width)
     mag = jnp.sqrt(jnp.sum(om * om, axis=-1))
     return jnp.max(mag.reshape(grid.nb, -1), axis=-1)
@@ -359,7 +370,7 @@ def vorticity_score(grid: BlockGrid, vel: jnp.ndarray, tab: LabTables):
 def gradchi_mask(grid: BlockGrid, chi: jnp.ndarray, tab: LabTables):
     """(nb,) bool: block touches the body interface (0 < chi < 1 anywhere
     or grad chi != 0) -> force max refinement (GradChiOnTmp)."""
-    clab = assemble_scalar_lab(chi, tab, grid.bs)
+    clab = tab.assemble_scalar(chi, grid.bs)
     g = grad_blocks(grid, clab, tab.width)
     has_grad = jnp.max(jnp.sum(g * g, axis=-1).reshape(grid.nb, -1), axis=-1) > 0
     return has_grad
@@ -410,9 +421,9 @@ def force_integrals_blocks(
     bs = grid.bs
     w = tab.width
     vol = _hcol(grid, vel.dtype) ** 3
-    clab = assemble_scalar_lab(chi, tab, bs)
+    clab = tab.assemble_scalar(chi, bs)
     gchi = grad_blocks(grid, clab, w)  # points into the body
-    vlab = assemble_vector_lab(vel, tab, bs)
+    vlab = tab.assemble_vector(vel, bs)
     g = _vel_gradients(grid, vlab, w)
     fpres = jnp.stack([jnp.sum(p * gchi[..., a] * vol) for a in range(3)])
     visc_tr = jnp.stack(
@@ -433,7 +444,7 @@ def force_integrals_blocks(
 
 def divergence_norms_blocks(grid: BlockGrid, vel: jnp.ndarray, tab: LabTables):
     """(sum |div u| h^3, max |div u|) over the forest."""
-    vlab = assemble_vector_lab(vel, tab, grid.bs)
+    vlab = tab.assemble_vector(vel, grid.bs)
     d = div_blocks(grid, vlab, tab.width)
     vol = _hcol(grid, vel.dtype) ** 3
     return jnp.sum(jnp.abs(d) * vol), jnp.max(jnp.abs(d))
@@ -446,7 +457,7 @@ def dissipation_blocks(grid: BlockGrid, vel: jnp.ndarray, nu: float,
     bs = grid.bs
     w = tab.width
     vol = _hcol(grid, vel.dtype) ** 3
-    vlab = assemble_vector_lab(vel, tab, bs)
+    vlab = tab.assemble_vector(vel, bs)
     g = _vel_gradients(grid, vlab, w)
     ss = 0.0
     for c in range(3):

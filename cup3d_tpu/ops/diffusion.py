@@ -40,12 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cup3d_tpu.grid.blocks import (
-    BlockGrid,
-    LabTables,
-    _assemble_vec_comp,
-)
-from cup3d_tpu.grid.flux import FluxTables, apply_flux_correction
+from cup3d_tpu.grid.blocks import BlockGrid, LabTables
+from cup3d_tpu.grid.flux import FluxTables
 from cup3d_tpu.grid.uniform import BC, UniformGrid
 from cup3d_tpu.ops import stencils as st
 from cup3d_tpu.ops.amr_ops import _sh
@@ -182,7 +178,7 @@ def helmholtz_comp_blocks(
     w = tab.width
     if inv_h is None:
         inv_h = 1.0 / jnp.asarray(grid.h.reshape(grid.nb, 1, 1, 1), x.dtype)
-    lab = _assemble_vec_comp(x, tab, bs, comp)
+    lab = tab.assemble_component(x, bs, comp)
     c = _sh(lab, w, bs)
     s = -6.0 * c
     for ax in range(3):
@@ -194,7 +190,7 @@ def helmholtz_comp_blocks(
     lap = s * inv_h * inv_h
     if flux_tab is not None and flux_tab.ncorr:
         fluxes = face_fluxes(lab, w, bs, inv_h)
-        lap = apply_flux_correction(lap, fluxes, flux_tab)
+        lap = flux_tab.apply(lap, fluxes)
     return x - nudt * lap
 
 
@@ -204,15 +200,21 @@ def build_amr_helmholtz_solver(
     tol_rel: float = 1e-4,
     maxiter: int = 1000,
     precond_iters: int = 12,
+    tab: Optional[LabTables] = None,
+    flux_tab: Optional[FluxTables] = None,
 ) -> Callable:
     """solve(u, nudt) -> (I - nudt lap)^{-1} u per component on the forest:
     the reference DiffusionSolver (main.cpp:6896-7146) with the shifted
-    getZ preconditioner (diffusion_kernels, main.cpp:10448-10580)."""
+    getZ preconditioner (diffusion_kernels, main.cpp:10448-10580).
+    ``tab``/``flux_tab`` may be pre-built or the sharded forest's
+    duck-typed equivalents."""
     from cup3d_tpu.grid.flux import build_flux_tables
     from cup3d_tpu.ops import krylov
 
-    tab = grid.lab_tables(1)
-    flux_tab = build_flux_tables(grid)
+    if tab is None:
+        tab = grid.lab_tables(1)
+    if flux_tab is None:
+        flux_tab = build_flux_tables(grid)
     h2 = jnp.asarray((grid.h**2).reshape(grid.nb, 1, 1, 1), jnp.float32)
     inv_h = 1.0 / jnp.sqrt(h2)
 
@@ -249,12 +251,11 @@ def advect_euler_blocks(
     tab: LabTables,
 ) -> jnp.ndarray:
     """Explicit advection-only Euler stage on the forest (KernelAdvect)."""
-    from cup3d_tpu.grid.blocks import assemble_vector_lab
     from cup3d_tpu.ops.amr_ops import _hcol, _upwind_d1
 
     bs = grid.bs
     w = tab.width
-    vlab = assemble_vector_lab(vel, tab, bs)
+    vlab = tab.assemble_vector(vel, bs)
     inv_h = 1.0 / _hcol(grid, vel.dtype)
     adv_u = _sh(vlab, w, bs) + uinf
     out = []

@@ -1,0 +1,213 @@
+"""Multi-device AMR: the sharded forest must reproduce the single-device
+forest bit-for-bit (labs, stencils, refluxing) and to reduction-order
+tolerance (Krylov solves) on the virtual 8-device CPU mesh.
+
+This covers the reference's L0 layer (SynchronizerMPI_AMR halo engine
+main.cpp:1515-2545, FluxCorrectionMPI 2546-2946, GridMPI partition
+2947-3364): the TPU equivalent is parallel/forest.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup3d_tpu.grid import adapt as ad
+from cup3d_tpu.grid.blocks import BlockGrid
+from cup3d_tpu.grid.flux import build_flux_tables
+from cup3d_tpu.grid.octree import Octree, TreeConfig
+from cup3d_tpu.grid.uniform import BC
+from cup3d_tpu.ops import amr_ops
+from cup3d_tpu.parallel.forest import ShardedForest, make_block_mesh
+
+BS = 8
+
+
+def _grid(bc=(BC.periodic,) * 3, refine=((0, 0, 0, 0), (0, 1, 1, 1))):
+    tree = Octree(
+        TreeConfig((2, 2, 2), 3, tuple(b == BC.periodic for b in bc)), 0
+    )
+    for k in refine:
+        tree.refine(k)
+    tree.assert_balanced()
+    return BlockGrid(tree, (1.0, 1.0, 1.0), bc)
+
+
+def _forest(g, n=8):
+    return ShardedForest(g, make_block_mesh(jax.devices()[:n]))
+
+
+def _rand(g, ncomp=0, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (g.nb, BS, BS, BS) + ((ncomp,) if ncomp else ())
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+
+
+@pytest.mark.parametrize("width", [1, 3])
+def test_sharded_labs_match_single_device(width):
+    g = _grid()
+    fo = _forest(g)
+    tab, stab = g.lab_tables(width), fo.lab_tables(width)
+    f, v = _rand(g), _rand(g, 3, seed=1)
+    np.testing.assert_array_equal(
+        np.asarray(fo.unpad(stab.assemble_scalar(fo.pad(f), BS))),
+        np.asarray(tab.assemble_scalar(f, BS)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fo.unpad(stab.assemble_vector(fo.pad(v), BS))),
+        np.asarray(tab.assemble_vector(v, BS)),
+    )
+
+
+def test_sharded_component_labs_closed_bc():
+    """Velocity sign ghosts (wall/freespace) survive the sharded path."""
+    g = _grid(bc=(BC.wall, BC.freespace, BC.periodic))
+    fo = _forest(g)
+    tab, stab = g.lab_tables(1), fo.lab_tables(1)
+    v = _rand(g, 3, seed=2)
+    for c in range(3):
+        np.testing.assert_array_equal(
+            np.asarray(fo.unpad(stab.assemble_component(fo.pad(v[..., c]), BS, c))),
+            np.asarray(tab.assemble_component(v[..., c], BS, c)),
+        )
+
+
+def test_sharded_refluxed_laplacian_exact():
+    g = _grid()
+    fo = _forest(g)
+    f = _rand(g, seed=3)
+    ref = amr_ops.laplacian_blocks(g, f, g.lab_tables(1), build_flux_tables(g))
+    sh = amr_ops.laplacian_blocks(
+        fo.geom, fo.pad(f), fo.lab_tables(1), fo.flux_tables
+    )
+    np.testing.assert_array_equal(np.asarray(fo.unpad(sh)), np.asarray(ref))
+
+
+def test_sharded_rk3_exact():
+    g = _grid()
+    fo = _forest(g)
+    v = 0.1 * _rand(g, 3, seed=4)
+    uinf = jnp.zeros(3, jnp.float32)
+    ref = amr_ops.rk3_step_blocks(
+        g, v, 1e-3, 1e-3, uinf, g.lab_tables(3), build_flux_tables(g)
+    )
+    sh = amr_ops.rk3_step_blocks(
+        fo.geom, fo.pad(v), 1e-3, 1e-3, uinf, fo.lab_tables(3),
+        fo.flux_tables,
+    )
+    np.testing.assert_array_equal(np.asarray(fo.unpad(sh)), np.asarray(ref))
+
+
+def test_sharded_bicgstab_matches_single_device():
+    """VERDICT r1 item 2: the *iterative* solver, sharded vs single-device,
+    equal to 1e-5."""
+    g = _grid()
+    fo = _forest(g)
+    rhs = _rand(g, seed=5)
+    ref = jax.jit(amr_ops.build_amr_poisson_solver(g))(rhs)
+    sh = fo.unpad(jax.jit(fo.build_poisson_solver())(fo.pad(rhs)))
+    np.testing.assert_allclose(
+        np.asarray(sh), np.asarray(ref), atol=1e-5, rtol=0
+    )
+    # and the answer actually solves the system
+    lap = amr_ops.laplacian_blocks(
+        g, jnp.asarray(np.asarray(sh)), g.lab_tables(1), build_flux_tables(g)
+    )
+    b = rhs - jnp.sum(
+        rhs * jnp.asarray((g.h**3).reshape(g.nb, 1, 1, 1), jnp.float32)
+    ) / (jnp.sum(jnp.asarray((g.h**3), jnp.float32)) * BS**3)
+    resid = float(jnp.max(jnp.abs(lap - b)))
+    assert resid < 5e-4
+
+
+def test_sharded_helmholtz_matches_single_device():
+    from cup3d_tpu.ops.diffusion import build_amr_helmholtz_solver
+
+    g = _grid()
+    fo = _forest(g)
+    v = 0.1 * _rand(g, 3, seed=6)
+    nudt = jnp.float32(1e-3 * 0.05)
+    h_ref = build_amr_helmholtz_solver(g)
+    h_sh = fo.build_helmholtz_solver()
+    ref = jax.jit(lambda u: h_ref(u, nudt))(v)
+    sh = fo.unpad(jax.jit(lambda u: h_sh(u, nudt))(fo.pad(v)))
+    np.testing.assert_allclose(
+        np.asarray(sh), np.asarray(ref), atol=1e-5, rtol=0
+    )
+
+
+def test_sharded_projection_divergence_drops():
+    """Full sharded pressure projection: matches single-device and drives
+    the divergence of a smooth field down ~30x."""
+    g = _grid()
+    fo = _forest(g)
+    x = np.asarray(g.cell_centers(np.float64))
+    v = jnp.asarray(
+        np.stack(
+            [
+                np.sin(2 * np.pi * x[..., 0]) * np.cos(2 * np.pi * x[..., 1]),
+                0.5 * np.cos(2 * np.pi * x[..., 0]) * np.sin(2 * np.pi * x[..., 1]),
+                np.sin(2 * np.pi * x[..., 2]),
+            ],
+            axis=-1,
+        ).astype(np.float32)
+    )
+    ref_solver = amr_ops.build_amr_poisson_solver(g)
+    vel_ref, _ = jax.jit(
+        lambda vel: amr_ops.project_blocks(
+            g, vel, 1e-2, ref_solver, g.lab_tables(1), build_flux_tables(g)
+        )
+    )(v)
+    tab1 = fo.lab_tables(1)
+    solver = fo.build_poisson_solver()
+    vel2, p = jax.jit(
+        lambda vel: amr_ops.project_blocks(
+            fo.geom, vel, 1e-2, solver, tab1, fo.flux_tables
+        )
+    )(fo.pad(v))
+    # both paths stop at the same residual gate; reduction order walks a
+    # slightly different iterate path, so equality holds to solver tolerance
+    np.testing.assert_allclose(
+        np.asarray(fo.unpad(vel2)), np.asarray(vel_ref), atol=5e-4, rtol=0
+    )
+    tot0, _ = amr_ops.divergence_norms_blocks(fo.geom, fo.pad(v), tab1)
+    tot1, _ = amr_ops.divergence_norms_blocks(fo.geom, vel2, tab1)
+    assert float(tot1) < 0.05 * float(tot0)
+
+
+def test_adaptation_rebuilds_forest():
+    """Adapt -> transfer -> new ShardedForest: sharded stepping continues
+    and matches single-device on the new topology (the reference's
+    re-_Setup of synchronizers + LoadBalancer, main.cpp:5086-5158)."""
+    g = _grid()
+    fo = _forest(g)
+    v = 0.1 * _rand(g, 3, seed=8)
+
+    score = np.zeros(g.nb)
+    score[0] = 1e9  # refine the first block (level 1 -> 2 allowed)
+    states = ad.tag_states(g, score, rtol=1.0, ctol=-1.0)
+    plan = ad.adapt(g, states)
+    assert plan is not None
+    v2 = ad.transfer_field(g, plan, v)
+    g2 = plan.new_grid
+    fo2 = _forest(g2)
+    uinf = jnp.zeros(3, jnp.float32)
+    ref = amr_ops.rk3_step_blocks(
+        g2, v2, 1e-3, 1e-3, uinf, g2.lab_tables(3), build_flux_tables(g2)
+    )
+    sh = amr_ops.rk3_step_blocks(
+        fo2.geom, fo2.pad(v2), 1e-3, 1e-3, uinf, fo2.lab_tables(3),
+        fo2.flux_tables,
+    )
+    np.testing.assert_array_equal(np.asarray(fo2.unpad(sh)), np.asarray(ref))
+
+
+def test_forest_on_fewer_devices():
+    """Partition correctness is device-count independent (1, 2, 3, 8)."""
+    g = _grid()
+    f = _rand(g, seed=9)
+    ref = np.asarray(g.lab_tables(1).assemble_scalar(f, BS))
+    for n in (1, 2, 3):
+        fo = _forest(g, n)
+        sh = np.asarray(fo.unpad(fo.lab_tables(1).assemble_scalar(fo.pad(f), BS)))
+        np.testing.assert_array_equal(sh, ref)
