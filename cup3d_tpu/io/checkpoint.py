@@ -1,0 +1,139 @@
+"""Checkpoint / restore of a full run.
+
+The reference parses ``-fsave/saveFreq`` (main.cpp:15381-15385) but ships
+no restart serialization (SURVEY.md section 5 names this a capability gap
+to fill).  Here a checkpoint is one self-contained pickle holding
+
+- the config (rebuilds solvers/operators deterministically),
+- the octree leaf keys (AMR) — topology is data, not pointers,
+- every field as numpy (bit-exact),
+- time/step/dt/uinf/lambda,
+- obstacle kinematic state (Obstacle.__getstate__ drops device arrays;
+  chi/udef are re-rasterized from the restored kinematics).
+
+``load_checkpoint`` reconstructs the driver and returns it ready to
+``simulate()``; a restored run reproduces the original trajectory to
+floating-point determinism of the jitted kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _driver_kind(driver) -> str:
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    return "amr" if isinstance(driver, AMRSimulation) else "uniform"
+
+
+def checkpoint_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{step:07d}.pkl")
+
+
+def save_checkpoint(driver, path: Optional[str] = None) -> str:
+    kind = _driver_kind(driver)
+    if kind == "amr":
+        state = driver.state
+        time, step, dt = driver.time, driver.step_idx, driver.dt
+        uinf, lam = driver.uinf, driver.lambda_penal
+        obstacles = driver.obstacles
+        leaves = np.asarray(driver.grid.keys, np.int64)
+        next_dump = driver._cadence.next_dump
+    else:
+        s = driver.sim
+        state = s.state
+        time, step, dt = s.time, s.step, s.dt
+        uinf, lam = s.uinf, s.lambda_penal
+        obstacles = s.obstacles
+        leaves = None
+        next_dump = s.cadence.next_dump
+    payload = {
+        "version": FORMAT_VERSION,
+        "kind": kind,
+        "cfg": dataclasses.asdict(driver.cfg),
+        "leaves": leaves,
+        "fields": {k: np.asarray(v) for k, v in state.items()},
+        "time": float(time),
+        "step": int(step),
+        "dt": float(dt),
+        "uinf": np.asarray(uinf, np.float64),
+        "lambda_penal": float(lam),
+        "next_dump": float(next_dump),
+        "obstacles": obstacles,
+    }
+    if path is None:
+        path = checkpoint_path(driver.cfg.path4serialization, int(step))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return path
+
+
+def load_checkpoint(path: str):
+    """Rebuild the driver (AMRSimulation or Simulation) from a checkpoint,
+    ready to continue stepping."""
+    from cup3d_tpu.config import SimulationConfig
+
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload["version"] != FORMAT_VERSION:
+        raise ValueError(f"unknown checkpoint version {payload['version']}")
+    cfg = SimulationConfig(**payload["cfg"])
+
+    if payload["kind"] == "amr":
+        from cup3d_tpu.grid.octree import Octree, TreeConfig
+        from cup3d_tpu.sim.amr import AMRSimulation
+
+        periodic = tuple(b == "periodic" for b in cfg.bc)
+        tree = Octree(
+            TreeConfig((cfg.bpdx, cfg.bpdy, cfg.bpdz), cfg.levelMax, periodic),
+            0,
+        )
+        tree.leaves.clear()
+        for l, i, j, k in payload["leaves"]:
+            tree.leaves[(int(l), int(i), int(j), int(k))] = None
+        tree.assert_balanced()
+        driver = AMRSimulation(cfg, tree=tree)
+        driver.state = {
+            k: jnp.asarray(v, driver.dtype) for k, v in payload["fields"].items()
+        }
+        driver.time = payload["time"]
+        driver.step_idx = payload["step"]
+        driver.dt = payload["dt"]
+        driver.uinf = payload["uinf"]
+        driver.lambda_penal = payload["lambda_penal"]
+        driver._cadence.next_dump = payload["next_dump"]
+        driver.obstacles = payload["obstacles"]
+        for ob in driver.obstacles:
+            ob.sim = driver
+        # rebuild chi/udef device fields from restored kinematics
+        driver.create_obstacles(0.0)
+        return driver
+
+    from cup3d_tpu.sim.simulation import Simulation
+
+    driver = Simulation(cfg)
+    s = driver.sim
+    s.state = {k: jnp.asarray(v, s.dtype) for k, v in payload["fields"].items()}
+    s.time = payload["time"]
+    s.step = payload["step"]
+    s.dt = payload["dt"]
+    s.uinf = payload["uinf"]
+    s.lambda_penal = payload["lambda_penal"]
+    s.cadence.next_dump = payload["next_dump"]
+    s.obstacles = payload["obstacles"]
+    for ob in s.obstacles:
+        ob.sim = s
+    driver._setup_operators()
+    if s.obstacles:
+        driver.pipeline[0](0.0)  # CreateObstacles: rebuild chi/udef
+    return driver

@@ -110,6 +110,27 @@ class Obstacle:
         self.visc_force = np.zeros(3)
         self.pow_out = 0.0
 
+    # -- checkpointing -----------------------------------------------------
+
+    def __getstate__(self):
+        """Pickle the kinematic/dynamic state only: the sim backref and all
+        device arrays (chi/udef/caches) are rebuilt by create_obstacles()
+        after restore (io/checkpoint.py)."""
+        state = {}
+        for k, v in self.__dict__.items():
+            if k == "sim" or isinstance(v, jax.Array):
+                continue
+            if k.endswith("_cache"):
+                continue
+            state[k] = v
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.sim = None
+        self.chi = None
+        self.udef = None
+
     # -- geometry ---------------------------------------------------------
 
     def rasterize(self, t: float):

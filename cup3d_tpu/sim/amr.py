@@ -45,7 +45,7 @@ _EPS = 1e-6
 
 
 class AMRSimulation:
-    def __init__(self, cfg: SimulationConfig):
+    def __init__(self, cfg: SimulationConfig, tree: Optional[Octree] = None):
         if cfg.bFixMassFlux:
             raise NotImplementedError(
                 "bFixMassFlux is only implemented on the uniform driver "
@@ -55,10 +55,13 @@ class AMRSimulation:
         self.cfg = cfg
         self.dtype = jnp.dtype(cfg.dtype)
         periodic = tuple(b == "periodic" for b in cfg.bc)
-        tree = Octree(
-            TreeConfig((cfg.bpdx, cfg.bpdy, cfg.bpdz), cfg.levelMax, periodic),
-            cfg.levelStart,
-        )
+        if tree is None:
+            tree = Octree(
+                TreeConfig(
+                    (cfg.bpdx, cfg.bpdy, cfg.bpdz), cfg.levelMax, periodic
+                ),
+                cfg.levelStart,
+            )
         self.grid = BlockGrid(
             tree, cfg.extents, tuple(BC(b) for b in cfg.bc), cfg.block_size
         )
@@ -72,6 +75,9 @@ class AMRSimulation:
         self.lambda_penal = cfg.lambda_penalization
         self.logger = BufferedLogger(cfg.path4serialization)
         self.profiler = Profiler()
+        from cup3d_tpu.io.dump import OutputCadence
+
+        self._cadence = OutputCadence(cfg.tdump, cfg.fdump, cfg.saveFreq)
         self._alloc_fields()
         self._rebuild()
 
@@ -107,7 +113,8 @@ class AMRSimulation:
         self._tab3 = g.lab_tables(3)
         self._ftab = build_flux_tables(g)
         self._solver = amr_ops.build_amr_poisson_solver(
-            g, tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel
+            g, tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel,
+            tab=self._tab1, flux_tab=self._ftab,
         )
         self._h_col = jnp.asarray(
             g.h.reshape(g.nb, 1, 1, 1), self.dtype
@@ -119,7 +126,8 @@ class AMRSimulation:
             from cup3d_tpu.ops import diffusion as dif
 
             helm = dif.build_amr_helmholtz_solver(
-                g, tol_abs=cfg.diffusionTol, tol_rel=cfg.diffusionTolRel
+                g, tol_abs=cfg.diffusionTol, tol_rel=cfg.diffusionTolRel,
+                tab=self._tab1, flux_tab=self._ftab,
             )
             self._advdiff = jax.jit(
                 lambda vel, dt, uinf: dif.implicit_step_blocks(
@@ -163,6 +171,17 @@ class AMRSimulation:
         )
         self._dissipation = jax.jit(
             lambda vel: amr_ops.dissipation_blocks(g, vel, self.nu, self._tab1)
+        )
+        self._omega_mag = jax.jit(
+            lambda vel: jnp.sqrt(
+                jnp.sum(
+                    amr_ops.curl_blocks(
+                        g, self._tab1.assemble_vector(vel, g.bs), self._tab1.width
+                    )
+                    ** 2,
+                    axis=-1,
+                )
+            )
         )
 
         def scores(vel, chi):
@@ -322,11 +341,36 @@ class AMRSimulation:
             self.lambda_penal = cfg.DLM / self.dt
         return self.dt
 
+    # -- output ------------------------------------------------------------
+
+    def _maybe_dump_save(self):
+        if self._cadence.dump_due(self.time, self.step_idx):
+            self.dump_fields()
+        if self._cadence.save_due(self.step_idx):
+            from cup3d_tpu.io.checkpoint import save_checkpoint
+
+            with self.profiler("Checkpoint"):
+                save_checkpoint(self)
+
+    def dump_fields(self):
+        import os
+
+        from cup3d_tpu.io import dump as dmp
+
+        fields = dmp.collect_dump_fields(self.cfg, self.state, self._omega_mag)
+        if fields:
+            prefix = os.path.join(
+                self.cfg.path4serialization, f"dump_{self.step_idx:07d}"
+            )
+            with self.profiler("Dump"):
+                dmp.dump_fields(prefix, self.time, self.grid, fields)
+
     def advance(self, dt: float):
         s = self.state
         dt_j = jnp.asarray(dt, self.dtype)
         uinf = self.uinf_device()
 
+        self._maybe_dump_save()
         if self.step_idx < 10 or self.step_idx % ADAPT_EVERY == 0:
             with self.profiler("AdaptMesh"):
                 self.adapt_mesh()

@@ -56,6 +56,9 @@ class SimulationData:
 
         self.logger = BufferedLogger(cfg.path4serialization)
         self.profiler = Profiler()
+        from cup3d_tpu.io.dump import OutputCadence
+
+        self.cadence = OutputCadence(cfg.tdump, cfg.fdump, cfg.saveFreq)
 
     @property
     def vel(self) -> jnp.ndarray:

@@ -104,8 +104,38 @@ class Simulation:
             s.lambda_penal = cfg.DLM / s.dt
         return s.dt
 
+    # -- output ------------------------------------------------------------
+
+    def _maybe_dump_save(self) -> None:
+        s = self.sim
+        if s.cadence.dump_due(s.time, s.step):
+            self.dump_fields()
+        if s.cadence.save_due(s.step):
+            from cup3d_tpu.io.checkpoint import save_checkpoint
+
+            with s.profiler("Checkpoint"):
+                save_checkpoint(self)
+
+    def dump_fields(self) -> None:
+        import os
+
+        from cup3d_tpu.io import dump as dmp
+
+        s, cfg = self.sim, self.cfg
+
+        def omega_mag(vel):
+            om = np.asarray(diag.vorticity(s.grid, vel))
+            return np.sqrt(np.sum(om**2, axis=-1))
+
+        fields = dmp.collect_dump_fields(cfg, s.state, omega_mag)
+        if fields:
+            prefix = os.path.join(cfg.path4serialization, f"dump_{s.step:07d}")
+            with s.profiler("Dump"):
+                dmp.dump_fields(prefix, s.time, s.grid, fields)
+
     def advance(self, dt: float) -> None:
         s = self.sim
+        self._maybe_dump_save()
         for op in self.pipeline:
             with s.profiler(op.name):
                 op(dt)

@@ -1,0 +1,144 @@
+"""Field dump (XDMF2 + raw, reference dump() main.cpp:429-553) and
+checkpoint/restore (SURVEY.md section 5 capability gap)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.config import SimulationConfig
+from cup3d_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+from cup3d_tpu.io.dump import dump_fields, read_dump
+
+
+def _uniform_cfg(tmp, **kw):
+    d = dict(
+        bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=1, extent=1.0,
+        CFL=0.3, nu=1e-3, tend=0.0, nsteps=4, initCond="taylorGreen",
+        poissonSolver="spectral", verbose=False, freqDiagnostics=0,
+        path4serialization=str(tmp),
+    )
+    d.update(kw)
+    return SimulationConfig(**d)
+
+
+def test_dump_uniform_roundtrip(tmp_path):
+    from cup3d_tpu.grid.uniform import BC, UniformGrid
+
+    g = UniformGrid((8, 8, 8), (1.0, 1.0, 1.0), (BC.periodic,) * 3)
+    rng = np.random.default_rng(0)
+    chi = rng.random((8, 8, 8)).astype(np.float32)
+    prefix = str(tmp_path / "snap")
+    dump_fields(prefix, 0.25, g, {"chi": chi})
+    centers, attr = read_dump(prefix + ".chi.xdmf2")
+    assert attr.shape == (512,)
+    np.testing.assert_allclose(attr, chi.reshape(-1), rtol=0, atol=0)
+    # cell centers land at (i+1/2)h
+    np.testing.assert_allclose(
+        sorted(set(np.round(centers[:, 0], 6))),
+        (np.arange(8) + 0.5) / 8.0,
+        atol=1e-6,
+    )
+
+
+def test_dump_blocks_mixed_levels(tmp_path):
+    from cup3d_tpu.grid.blocks import BlockGrid
+    from cup3d_tpu.grid.octree import Octree, TreeConfig
+    from cup3d_tpu.grid.uniform import BC
+
+    tree = Octree(TreeConfig((2, 2, 2), 2, (True,) * 3), 0)
+    tree.refine((0, 0, 0, 0))
+    g = BlockGrid(tree, (1.0, 1.0, 1.0), (BC.periodic,) * 3)
+    f = np.arange(g.nb * 512, dtype=np.float32).reshape(g.nb, 8, 8, 8)
+    prefix = str(tmp_path / "amr")
+    dump_fields(prefix, 0.0, g, {"chi": f})
+    centers, attr = read_dump(prefix + ".chi.xdmf2")
+    assert attr.size == g.nb * 512
+    np.testing.assert_allclose(attr, f.reshape(-1))
+    # all centers inside the unit box, and two distinct spacings appear
+    assert centers.min() > 0 and centers.max() < 1
+    xyz = np.fromfile(prefix + ".xyz.raw", np.float32).reshape(-1, 8, 3)
+    hs = np.unique(np.round(xyz[:, 6, 0] - xyz[:, 0, 0], 9))
+    assert len(hs) == 2  # level-1 fine cells + the coarse remainder
+
+
+def test_checkpoint_restore_uniform_bitexact(tmp_path):
+    from cup3d_tpu.sim.simulation import Simulation
+
+    cfg = _uniform_cfg(tmp_path, nsteps=6)
+    ref = Simulation(cfg)
+    ref.init()
+    # run 3, save, run 3 more
+    for _ in range(3):
+        ref.advance(ref.calc_max_timestep())
+    path = save_checkpoint(ref, str(tmp_path / "ck.pkl"))
+    tail = []
+    for _ in range(3):
+        ref.advance(ref.calc_max_timestep())
+        tail.append(np.asarray(ref.sim.state["vel"]))
+
+    res = load_checkpoint(path)
+    assert res.sim.step == 3
+    for i in range(3):
+        res.advance(res.calc_max_timestep())
+        np.testing.assert_array_equal(np.asarray(res.sim.state["vel"]), tail[i])
+
+
+def test_checkpoint_restore_amr_with_fish(tmp_path):
+    """AMR + StefanFish checkpoint: restored run continues and stays close
+    (obstacle kinematics, octree, and fields all survive)."""
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    factory = (
+        "StefanFish L=0.3 T=1.0 xpos=0.5 ypos=0.5 zpos=0.5 "
+        "bFixFrameOfRef=1 heightProfile=stefan widthProfile=stefan"
+    )
+    cfg = SimulationConfig(
+        bpdx=1, bpdy=1, bpdz=1, levelMax=3, levelStart=1, extent=1.0,
+        CFL=0.4, nu=1e-4, tend=0.0, nsteps=4, factory_content=factory,
+        poissonSolver="iterative", poissonTol=1e-4, poissonTolRel=1e-2,
+        verbose=False, freqDiagnostics=0, Rtol=1e9, Ctol=-1.0,
+        path4serialization=str(tmp_path),
+    )
+    sim = AMRSimulation(cfg)
+    sim.init()
+    for _ in range(2):
+        sim.advance(sim.calc_max_timestep())
+    nb_saved = sim.grid.nb
+    pos_saved = sim.obstacles[0].position.copy()
+    path = save_checkpoint(sim, str(tmp_path / "ck_amr.pkl"))
+
+    res = load_checkpoint(path)
+    assert res.grid.nb == nb_saved
+    assert res.step_idx == 2
+    np.testing.assert_allclose(res.obstacles[0].position, pos_saved)
+    np.testing.assert_array_equal(
+        np.asarray(res.state["vel"]), np.asarray(sim.state["vel"])
+    )
+    # fish kinematic state (schedulers, PID) survived: same next midline
+    res.advance(res.calc_max_timestep())
+    sim.advance(sim.calc_max_timestep())
+    np.testing.assert_allclose(
+        res.obstacles[0].position, sim.obstacles[0].position, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.state["vel"]), np.asarray(sim.state["vel"]), atol=2e-5
+    )
+
+
+def test_dump_cadence_and_savefreq(tmp_path):
+    from cup3d_tpu.sim.simulation import Simulation
+
+    cfg = _uniform_cfg(
+        tmp_path, nsteps=4, fdump=2, saveFreq=2, dumpChi=True,
+        dumpVelocity=True, dumpOmega=True,
+    )
+    s = Simulation(cfg)
+    s.init()
+    while s.sim.step < cfg.nsteps:
+        s.advance(s.calc_max_timestep())
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("dump_0000000") and f.endswith(".chi.xdmf2") for f in files)
+    assert any(f.endswith(".velx.attr.raw") for f in files)
+    assert any(f.endswith(".omega.attr.raw") for f in files)
+    assert "ckpt_0000002.pkl" in files
