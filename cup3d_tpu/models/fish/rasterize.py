@@ -55,9 +55,29 @@ def _segment_distance(p, seg):
     u = jnp.einsum("...c,...c->...", d2, nor)
     v = jnp.einsum("...c,...c->...", d2, bn)
     q = jnp.sqrt((u / w) ** 2 + (v / hh) ** 2 + 1e-30)
-    # first-order signed distance to the ellipse: f/|grad f|, f = q - 1
-    grad = jnp.sqrt((u / w**2) ** 2 + (v / hh**2) ** 2 + 1e-30)
-    d_plane = (q - 1.0) * q / grad
+    # first-order signed distance to the ellipse: f/|grad f| with f = q - 1.
+    # |grad f| = hypot(u/w^2, v/h^2)/q is computed via the *unit* direction
+    # (t1, t2) = (u/w, v/h)/q so nothing divides by w^2/h^2 directly: at the
+    # degenerate tip sections (w = h = 1e-10) u/w^2 overflows float32 to
+    # inf, which used to zero the in-plane distance and mark far-field
+    # cells as near-surface (spurious chi bands across the whole domain).
+    t1 = (u / w) / q
+    t2 = (v / hh) / q
+    inv_ratio = jnp.sqrt((t1 / w) ** 2 + (t2 / hh) ** 2 + 1e-30)
+    # infimum of |grad f| over directions is 1/max(w, h): floor it so the
+    # exactly-on-axis case stays at the physical depth scale
+    inv_ratio = jnp.maximum(inv_ratio, 1.0 / jnp.maximum(w, hh))
+    # f/|grad f| is accurate only near the surface; for eccentric sections
+    # it underestimates far-field distance by the axis ratio (the thin
+    # tail would paint spurious near-surface bands across the domain).
+    # hypot(u, v) - max(w, h) is a rigorous lower bound everywhere (point
+    # distance to the section's bounding circle), exact in the far field:
+    # take the larger of the two (both are lower bounds outside; inside,
+    # the bound is positive only if the point is provably outside)
+    d_plane = jnp.maximum(
+        (q - 1.0) / inv_ratio,
+        jnp.hypot(u, v) - jnp.maximum(w, hh),
+    )
     ax_abs = jnp.abs(ax)
     d_signed = jnp.where(
         ax_abs > 0.0, jnp.hypot(jnp.maximum(d_plane, 0.0), ax_abs), d_plane

@@ -105,3 +105,46 @@ def test_stefanfish_rl_interface():
     assert abs(fish.get_learn_t_period() - 1.1) < 1e-12
     sim.simulate()
     assert np.all(np.isfinite(np.asarray(sim.sim.state["vel"])))
+
+
+def test_rasterize_degenerate_tips_far_field():
+    """Regression: sections with width=height~0 (fish nose/tail tips) must
+    not paint near-surface sdf far from the body.  The f/|grad f| ellipse
+    distance both overflowed float32 at w=h=1e-10 (u/w^2 -> inf) and
+    underestimates far-field distance for eccentric sections; far cells
+    then carried |sdf| ~ h and chi banded the whole domain."""
+    from cup3d_tpu.models.fish.rasterize import rasterize_points
+
+    nm = 32
+    s = np.linspace(0, 0.3, nm)
+    taper = np.sin(np.pi * s / 0.3)  # exact zeros at both tips
+    z = np.zeros((nm, 3))
+    mid = {
+        "r": jnp.asarray(np.stack([s, np.zeros(nm), np.zeros(nm)], 1), jnp.float32),
+        "v": jnp.asarray(z, jnp.float32),
+        "nor": jnp.asarray(np.tile([0.0, 1.0, 0.0], (nm, 1)), jnp.float32),
+        "vnor": jnp.asarray(z, jnp.float32),
+        "bin": jnp.asarray(np.tile([0.0, 0.0, 1.0], (nm, 1)), jnp.float32),
+        "vbin": jnp.asarray(z, jnp.float32),
+        # eccentric sections: thin width, taller height, hard-zero tips
+        "width": jnp.asarray(0.002 * taper, jnp.float32),
+        "height": jnp.asarray(0.04 * taper, jnp.float32),
+    }
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(-0.5, 0.8, (4096, 3)).astype(np.float32)
+    pos = jnp.zeros(3, jnp.float32)
+    rot = jnp.eye(3, dtype=jnp.float32)
+    sdf, _ = rasterize_points(jnp.asarray(pts), mid, pos, rot)
+    sdf = np.asarray(sdf)
+    # true distance to the midline polyline (body is thinner than this)
+    r = np.stack([s, np.zeros(nm), np.zeros(nm)], 1)
+    td = np.min(
+        np.linalg.norm(pts[:, None, :] - r[None], axis=-1), axis=1
+    )
+    far = td > 0.15
+    assert far.sum() > 1000
+    # every far point must be clearly outside: sdf <= -(dist - max height)
+    assert float(sdf[far].max()) < -0.1
+    # and the signed distance tracks the true distance in the far field
+    err = np.abs(-sdf[far] - td[far])
+    assert float(err.max()) < 0.05
