@@ -70,6 +70,7 @@ class SimulationConfig:
 
     # -- obstacles --
     factory_content: str = ""
+    factory: str = ""  # path to a factory file (one obstacle per line)
 
     # -- output / diagnostics (main.cpp:15381-15387) --
     freqDiagnostics: int = 0
@@ -90,6 +91,16 @@ class SimulationConfig:
             self.levelStart = self.levelMax - 1
         if self.levelMaxVorticity < 0:
             self.levelMaxVorticity = self.levelMax
+
+    def resolved_factory_content(self) -> str:
+        """factory_content plus the lines of the ``factory`` file, if any
+        (reference ObstacleFactory reads both, main.cpp:13247-13267)."""
+        content = self.factory_content
+        if self.factory:
+            with open(self.factory) as f:
+                lines = f.read()
+            content = f"{content}\n{lines}" if content else lines
+        return content
 
     @property
     def bc(self) -> Tuple[str, str, str]:
@@ -112,6 +123,7 @@ class SimulationConfig:
 
 # reference flag name -> dataclass field
 _FLAG_ALIASES = {
+    "extentx": "extent",  # run.sh spells the domain size -extentx
     "levelMax": "levelMax",
     "levelStart": "levelStart",
     "lambda": "lambda_penalization",

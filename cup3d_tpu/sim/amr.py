@@ -172,6 +172,11 @@ class AMRSimulation:
         self._dissipation = jax.jit(
             lambda vel: amr_ops.dissipation_blocks(g, vel, self.nu, self._tab1)
         )
+        self._gradchi = jax.jit(
+            lambda chi: amr_ops.grad_blocks(
+                g, self._tab1.assemble_scalar(chi, g.bs), self._tab1.width
+            )
+        )
         self._omega_mag = jax.jit(
             lambda vel: jnp.sqrt(
                 jnp.sum(
@@ -204,11 +209,12 @@ class AMRSimulation:
     # -- obstacles ---------------------------------------------------------
 
     def _add_obstacles(self):
-        if not self.cfg.factory_content:
+        content = self.cfg.resolved_factory_content()
+        if not content:
             return
         from cup3d_tpu.models.factory import make_obstacles
 
-        self.obstacles = make_obstacles(self, parse_factory(self.cfg.factory_content))
+        self.obstacles = make_obstacles(self, parse_factory(content))
 
     def create_obstacles(self, dt: float = 0.0):
         """Reference CreateObstacles (main.cpp:13589-13621) on blocks."""
@@ -390,6 +396,18 @@ class AMRSimulation:
                     )
                     ob.update(dt)
             with self.profiler("Penalization"):
+                if len(self.obstacles) > 1:
+                    from cup3d_tpu.models.collisions import (
+                        prevent_colliding_obstacles,
+                    )
+
+                    prevent_colliding_obstacles(
+                        self.obstacles,
+                        [self._obstacle_ubody(ob) for ob in self.obstacles],
+                        self._gradchi,
+                        self._xc,
+                        dt,
+                    )
                 s["vel"] = self._penalize(
                     s["vel"], s["chi"], self._body_velocity(),
                     jnp.asarray(self.lambda_penal, self.dtype), dt_j,

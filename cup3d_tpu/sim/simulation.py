@@ -64,11 +64,12 @@ class Simulation:
         self.pipeline = pipe
 
     def _add_obstacles(self) -> None:
-        if not self.cfg.factory_content:
+        content = self.cfg.resolved_factory_content()
+        if not content:
             return
         from cup3d_tpu.models.factory import make_obstacles
 
-        self.sim.obstacles = make_obstacles(self.sim, parse_factory(self.cfg.factory_content))
+        self.sim.obstacles = make_obstacles(self.sim, parse_factory(content))
 
     # -- time stepping -----------------------------------------------------
 

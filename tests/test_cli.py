@@ -1,0 +1,68 @@
+"""CLI entry point (reference main() + run.sh, main.cpp:15982-15994)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cup3d_tpu.__main__ import build_driver, main
+
+
+def test_runsh_command_line_launches(tmp_path):
+    """The reference acceptance command line (run.sh, translated flags,
+    reduced size) round-trips: two StefanFish on the adaptive forest."""
+    argv = (
+        "-bMeanConstraint 2 -bpdx 1 -bpdy 1 -bpdz 1 -CFL 0.4 -Ctol 0.1 "
+        "-extentx 1 -factory-content "
+        "'StefanFish L=0.4 T=1.0 xpos=0.3 ypos=0.5 zpos=0.5 planarAngle=180 "
+        "heightProfile=danio widthProfile=stefan bFixFrameOfRef=1\n"
+        "StefanFish L=0.4 T=1.0 xpos=0.7 ypos=0.5 zpos=0.5 "
+        "heightProfile=danio widthProfile=stefan' "
+        "-levelMax 2 -levelStart 1 -nu 0.001 -poissonSolver iterative "
+        "-Rtol 5 -tdump 0 -tend 0 -nsteps 2"
+    )
+    import shlex
+
+    argv = shlex.split(argv) + [
+        "-path4serialization", str(tmp_path), "-verbose", "0",
+        "-poissonTol", "1e-3", "-poissonTolRel", "1e-2",
+    ]
+    main(argv)
+    assert os.path.exists(tmp_path / "argumentparser.log")
+
+
+def test_driver_selection():
+    amr = build_driver(["-levelMax", "2", "-nsteps", "1", "-verbose", "0"])
+    from cup3d_tpu.sim.amr import AMRSimulation
+    from cup3d_tpu.sim.simulation import Simulation
+
+    assert isinstance(amr, AMRSimulation)
+    uni = build_driver(
+        ["-levelMax", "1", "-bpdx", "2", "-bpdy", "2", "-bpdz", "2",
+         "-nsteps", "1", "-verbose", "0"]
+    )
+    assert isinstance(uni, Simulation)
+
+
+def test_conf_file_and_factory_file(tmp_path):
+    conf = tmp_path / "case.conf"
+    conf.write_text(
+        "# a comment\n-bpdx 2 -bpdy 2 -bpdz 2\n-levelMax 1\n-nu 0.002\n"
+    )
+    fac = tmp_path / "school.factory"
+    fac.write_text(
+        "StefanFish L=0.2 T=1.0 xpos=0.4\nStefanFish L=0.2 T=1.0 xpos=0.6\n"
+    )
+    d = build_driver(
+        ["-nu", "0.005", "-conf", str(conf), "-factory", str(fac),
+         "-verbose", "0"]
+    )
+    assert d.cfg.bpdx == 2
+    assert d.cfg.nu == 0.005  # CLI wins over conf file
+    from cup3d_tpu.config import parse_factory
+
+    specs = parse_factory(d.cfg.resolved_factory_content())
+    assert len(specs) == 2 and specs[0]["type"] == "StefanFish"
+    assert len(d.sim.obstacles) == 0  # not built until init()
+    d.init()
+    assert len(d.sim.obstacles) == 2  # factory file consumed by the driver
