@@ -109,6 +109,11 @@ class Obstacle:
         self.pres_force = np.zeros(3)
         self.visc_force = np.zeros(3)
         self.pow_out = 0.0
+        # collision latch (reference collision_counter/u_collision,
+        # main.cpp:7546-7552, 13069-13077)
+        self.collision_counter = 0.0
+        self.collision_vel = np.zeros(3)
+        self.collision_angvel = np.zeros(3)
 
     # -- checkpointing -----------------------------------------------------
 
@@ -181,6 +186,12 @@ class Obstacle:
         new_ut, new_om = sol[:3], sol[3:]
         self.transVel = np.where(self.bForcedInSimFrame, self.transVel, new_ut)
         self.angVel = np.where(self.bBlockRotation, self.angVel, new_om)
+        # a fresh collision overrides the fluid-coupled solve for one step
+        # (reference main.cpp:13069-13077)
+        if self.collision_counter > 0:
+            self.collision_counter -= self.sim.dt
+            self.transVel = self.collision_vel.copy()
+            self.angVel = self.collision_angvel.copy()
 
     def update(self, dt: float) -> None:
         """Advance position/orientation (reference update, main.cpp:13116-13204)."""

@@ -1,0 +1,188 @@
+"""Obstacle-obstacle collision handling.
+
+Reference: ``preventCollidingObstacles`` + ``ElasticCollision``
+(main.cpp:13939-14325).  Per obstacle pair the reference scans cells where
+both bodies' SDFs are positive, accumulating the overlap-cell count, the
+overlap centroid, each body's mean (normalized) SDF-gradient direction,
+and a representative body-point velocity (the max-|u| overlap point); if
+the bodies approach along the contact normal it applies an e=1 rigid-body
+impulse (with inertia coupling) and latches the resulting velocities for
+one step (``collision_counter``, main.cpp:13069-13077).
+
+TPU shape: the overlap scan is one fused masked reduction per pair over
+the dense per-obstacle chi fields (chi > 1/2 is the SDF > 0 interior), the
+contact direction comes from grad(chi) (same inward orientation as the
+reference's SDF gradient), and the tiny 3x3 impulse algebra runs on host
+— mirroring the reference's split of grid scan (OpenMP+MPI) vs pair loop
+(serial).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TOL_CELLS = 0.001  # minimum overlap count (reference `tolerance`)
+
+
+@jax.jit
+def overlap_count(chi_i: jnp.ndarray, chi_j: jnp.ndarray) -> jnp.ndarray:
+    """Cheap pre-check: number of cells inside both bodies."""
+    return jnp.sum((chi_i > 0.5) & (chi_j > 0.5))
+
+
+@jax.jit
+def pair_overlap_summary(
+    chi_i: jnp.ndarray,
+    chi_j: jnp.ndarray,
+    gchi_i: jnp.ndarray,
+    gchi_j: jnp.ndarray,
+    ub_i: jnp.ndarray,
+    ub_j: jnp.ndarray,
+    xc: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """Masked overlap reductions for one obstacle pair.
+
+    chi_*: (...,) characteristic functions; gchi_*: (..., 3) chi gradients;
+    ub_*: (..., 3) body-point velocity fields (rigid + deformation);
+    xc: (..., 3) cell centers.  The reference accumulates i and j stats
+    over the *same* overlap cells (main.cpp:14030-14140), so the count and
+    centroid are shared.
+    """
+    mask = (chi_i > 0.5) & (chi_j > 0.5)
+    mf = mask.reshape(-1).astype(chi_i.dtype)
+    xf = xc.reshape(-1, 3)
+    m = jnp.sum(mf)
+    pos = mf @ xf
+
+    def dirsum(g):
+        gf = g.reshape(-1, 3)
+        n = jnp.sqrt(jnp.sum(gf * gf, axis=-1, keepdims=True)) + 1e-21
+        return mf @ (gf / n)
+
+    def rep_vel(ub):
+        uf = ub.reshape(-1, 3)
+        mag = jnp.sum(uf * uf, axis=-1) * mf
+        return uf[jnp.argmax(mag)]
+
+    return {
+        "m": m,
+        "pos": pos,
+        "ivec": dirsum(gchi_i),
+        "jvec": dirsum(gchi_j),
+        "imom": rep_vel(ub_i),
+        "jmom": rep_vel(ub_j),
+    }
+
+
+def _inertia_response(J: np.ndarray, rc: np.ndarray, n: np.ndarray):
+    """I^{-1} (rc x n): the angular velocity change per unit impulse
+    (reference ComputeJ, main.cpp:13939-13966)."""
+    Jm = np.asarray(J, np.float64)
+    Jm = Jm + 1e-21 * np.trace(Jm) * np.eye(3) + 1e-30 * np.eye(3)
+    return np.linalg.solve(Jm, np.cross(rc, n))
+
+
+def elastic_collision(m1, m2, J1, J2, v1, v2, o1, o2, c1, c2, n, c, vc1, vc2):
+    """e=1 impulse between two rigid bodies (reference ElasticCollision,
+    main.cpp:13968-14027).  n: contact normal (i -> j); c: contact point;
+    vc1/vc2: representative contact-point velocities.  Returns
+    (v1', v2', o1', o2')."""
+    e = 1.0
+    jr1 = _inertia_response(J1, c - c1, n)
+    jr2 = _inertia_response(J2, c - c2, n)
+    nom = (1.0 + e) * np.dot(vc1 - vc2, n)
+    denom = -(1.0 / m1 + 1.0 / m2) - (
+        np.dot(np.cross(jr1, c - c1), n) + np.dot(np.cross(jr2, c - c2), n)
+    )
+    impulse = nom / (denom + 1e-21)
+    return (
+        v1 + (n / m1) * impulse,
+        v2 - (n / m2) * impulse,
+        o1 + jr1 * impulse,
+        o2 - jr2 * impulse,
+    )
+
+
+def prevent_colliding_obstacles(
+    obstacles: List,
+    ubody_fields: List[jnp.ndarray],
+    gradchi_fn,
+    xc: jnp.ndarray,
+    dt: float,
+) -> bool:
+    """Detect overlapping obstacle pairs and resolve them with an elastic
+    impulse; latch the collision velocities for one step.  Returns whether
+    any collision fired (reference sim.bCollision).
+
+    gradchi_fn: chi -> (..., 3) gradient on the driver's layout.
+    """
+    n_obs = len(obstacles)
+    if n_obs < 2:
+        return False
+    # gradients are only needed for pairs that actually overlap; keep the
+    # no-contact common case to one cheap masked count per pair
+    grads: Dict[int, jnp.ndarray] = {}
+
+    def grad(k):
+        if k not in grads:
+            grads[k] = gradchi_fn(obstacles[k].chi)
+        return grads[k]
+
+    hit = False
+    for i in range(n_obs):
+        for j in range(i + 1, n_obs):
+            oi, oj = obstacles[i], obstacles[j]
+            if float(overlap_count(oi.chi, oj.chi)) < _TOL_CELLS:
+                continue
+            s = pair_overlap_summary(
+                oi.chi, oj.chi, grad(i), grad(j),
+                ubody_fields[i], ubody_fields[j], xc,
+            )
+            m = float(s["m"])
+            if m < _TOL_CELLS:
+                continue
+            ivec = np.asarray(s["ivec"], np.float64)
+            jvec = np.asarray(s["jvec"], np.float64)
+            ni = np.linalg.norm(ivec)
+            nj = np.linalg.norm(jvec)
+            if ni < 1e-21 or nj < 1e-21:
+                continue
+            # contact normal: difference of the two inward gradient
+            # directions; grad chi points INTO each body, so ivec/ni points
+            # from the interface into body i -> n points j -> i
+            mvec = ivec / ni - jvec / nj
+            mn = np.linalg.norm(mvec)
+            if mn < 1e-21:
+                continue
+            n = mvec / mn
+            imom = np.asarray(s["imom"], np.float64)
+            jmom = np.asarray(s["jmom"], np.float64)
+            # approach test (main.cpp:14262-14266): relative velocity of j
+            # w.r.t. i along n must close the gap
+            if np.dot(jmom - imom, n) <= 0:
+                continue
+            hit = True
+            c = np.asarray(s["pos"], np.float64) / m
+            m1 = oi.mass if oi.mass > 0 else 1.0
+            m2 = oj.mass if oj.mass > 0 else 1.0
+            # forced bodies are effectively immovable (main.cpp:14293-14298)
+            if np.any(oi.bForcedInSimFrame):
+                m1 *= 1e10
+            if np.any(oj.bForcedInSimFrame):
+                m2 *= 1e10
+            v1, v2, o1, o2 = elastic_collision(
+                m1, m2, oi.J, oj.J, oi.transVel, oj.transVel,
+                oi.angVel, oj.angVel, oi.centerOfMass, oj.centerOfMass,
+                n, c, imom, jmom,
+            )
+            for ob, v, o in ((oi, v1, o1), (oj, v2, o2)):
+                ob.transVel = np.asarray(v)
+                ob.angVel = np.asarray(o)
+                ob.collision_vel = np.asarray(v)
+                ob.collision_angvel = np.asarray(o)
+                ob.collision_counter = 0.01 * dt
+    return hit

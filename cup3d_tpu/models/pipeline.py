@@ -65,21 +65,33 @@ class UpdateObstacles(Operator):
 
 
 class Penalization(Operator):
-    """Brinkman forcing toward the combined body velocity field
-    (reference Penalization, main.cpp:14326-14341).  Collision handling
-    (main.cpp:13939-14325) is applied in UpdateObstacles order upstream;
-    here pending (see SURVEY.md section 2 L3b: Collision)."""
+    """Collision handling then Brinkman forcing toward the combined body
+    velocity field (reference Penalization, main.cpp:14326-14341:
+    preventCollidingObstacles runs first, main.cpp:14330)."""
 
     def __init__(self, sim: SimulationData):
         super().__init__(sim)
         self._penalize = jax.jit(penalize)
+        from cup3d_tpu.ops.chi import grad_chi
+
+        self._gradchi = jax.jit(partial(grad_chi, sim.grid))
+        self._xc = sim.grid.cell_centers(sim.dtype)
 
     def __call__(self, dt):
         s = self.sim
         if not s.obstacles:
             return
+        ubs = [ob.body_velocity_field() for ob in s.obstacles]
+        if len(s.obstacles) > 1:
+            from cup3d_tpu.models.collisions import prevent_colliding_obstacles
+
+            if prevent_colliding_obstacles(
+                s.obstacles, ubs, self._gradchi, self._xc, float(dt)
+            ):
+                # collision overrode rigid velocities: rebuild the fields
+                ubs = [ob.body_velocity_field() for ob in s.obstacles]
         chis = jnp.stack([ob.chi for ob in s.obstacles])
-        num = sum(ob.chi[..., None] * ob.body_velocity_field() for ob in s.obstacles)
+        num = sum(ob.chi[..., None] * ub for ob, ub in zip(s.obstacles, ubs))
         den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
         ubody = num / den
         s.state["vel"] = self._penalize(
