@@ -1,12 +1,30 @@
-"""Headline benchmark: cell-updates/sec for one full NS timestep
-(RK3 advection-diffusion + spectral pressure projection) on a 256^3
-uniform grid — BASELINE.md config #3's resolution, obstacle-free.
+"""Benchmark suite: the BASELINE.md configs that exist, on real hardware.
 
-Prints ONE JSON line.  `vs_baseline` compares against 1.3e8 cell-updates/s,
+Primary metric (the "metric" field): cell-updates/sec on BASELINE config
+number 2 — the 128^3 uniform self-propelled StefanFish with the iterative
+getZ-preconditioned BiCGSTAB Poisson solve at the reference quality bar
+(abs 1e-6 / rel 1e-4, main.cpp:15364-15365).  This runs the full pipeline
+every step: midline kinematics, SDF rasterization, chi, momenta/6x6 solve,
+penalization, pressure projection, force reduction.
+
+Also reported inside the same single JSON line:
+- wall-clock/step and a per-operator wall-clock breakdown (host-timed, so
+  async device work is attributed to the operator that forces the sync);
+- BiCGSTAB iterations-to-tolerance and iterations/sec on the fish state's
+  actual pressure system, cold and warm-started;
+- max |div u| after projection (the correctness gate, main.cpp:8889-8919);
+- secondary configs: 256^3 Taylor-Green with the iterative solver,
+  the 256^3 spectral-projection step (round-1's headline), and the run.sh
+  two-fish adaptive-mesh case (wall/step, blocks, div).
+
+`vs_baseline` compares the primary metric against 1.3e8 cell-updates/s,
 a documented estimate for the reference on 64 MPI ranks (the reference
-publishes no numbers and cannot be built here — no mpicxx/GSL; CubismUP-class
-codes sustain ~2e6 cell-updates/s/core on full NS steps at matched Poisson
-tolerance, see BASELINE.md).
+publishes no numbers and cannot be built here — no mpicxx/GSL;
+CubismUP-class codes sustain ~2e6 cell-updates/s/core on full NS steps at
+matched Poisson tolerance, see BASELINE.md).
+
+Env knobs: CUP3D_BENCH_CONFIG=fish|tgv|spectral|amr|all (default all),
+CUP3D_BENCH_N (downscale resolutions for CPU smoke testing).
 """
 
 import json
@@ -15,50 +33,273 @@ import time
 
 import numpy as np
 
-BASELINE_CELLS_PER_SEC = 1.3e8  # 64-rank MPI CPU estimate (see module docstring)
+BASELINE_CELLS_PER_SEC = 1.3e8  # 64-rank MPI CPU estimate (module docstring)
 
 
-def main():
+def _scaled(n_default: int) -> int:
+    n = int(os.environ.get("CUP3D_BENCH_N", "0"))
+    if n <= 0:
+        return n_default
+    return max(16, (n // 8) * 8)  # grids are built from 8^3 blocks
+
+
+def _time_steps(advance, calc_dt, warmup: int, iters: int) -> float:
+    for _ in range(warmup):
+        advance(calc_dt())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        advance(calc_dt())
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_fish_uniform():
+    """BASELINE config #2: 128^3 uniform self-propelled fish, iterative
+    Poisson at 1e-6/1e-4."""
+    import jax.numpy as jnp
+
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.ops import krylov
+    from cup3d_tpu.ops.projection import pressure_rhs
+    from cup3d_tpu.sim.simulation import Simulation
+
+    n = _scaled(128)
+    bpd = n // 8
+    cfg = SimulationConfig(
+        bpdx=bpd, bpdy=bpd, bpdz=bpd, levelMax=1, levelStart=0, extent=1.0,
+        CFL=0.4, nu=1e-3, tend=0.0, nsteps=10**9, rampup=0,
+        poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+        factory_content=(
+            "StefanFish L=0.4 T=1.0 xpos=0.5 ypos=0.5 zpos=0.5 "
+            "bFixFrameOfRef=1 heightProfile=danio widthProfile=stefan"
+        ),
+        verbose=False, freqDiagnostics=0,
+    )
+    sim = Simulation(cfg)
+    sim.init()
+    iters = 8
+    wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=3,
+                       iters=iters)
+    cells_s = n**3 / wall
+
+    from cup3d_tpu.ops import diagnostics as diag
+
+    _, div_max = diag.divergence_norms(sim.sim.grid, sim.sim.state["vel"])
+
+    # BiCGSTAB microbenchmark on this state's actual pressure system
     import jax
+
+    s = sim.sim
+    grid = s.grid
+    A = krylov.make_laplacian(grid)
+    M = krylov.make_block_cg_preconditioner(8, 12, h=grid.h)
+    rhs = pressure_rhs(grid, s.state["vel"], s.dt, s.state["chi"],
+                       s.state["udef"])
+    rhs = rhs - jnp.mean(rhs)
+
+    @jax.jit
+    def solve(b, x0):
+        return krylov.bicgstab(A, b, M=M, x0=x0, tol_abs=1e-6, tol_rel=1e-4)
+
+    x, _, k_cold = solve(rhs, jnp.zeros_like(rhs))
+    float(x[0, 0, 0])
+    t0 = time.perf_counter()
+    x2, _, k2 = solve(rhs, jnp.zeros_like(rhs))
+    k2 = int(k2)  # forced sync
+    t_cold = time.perf_counter() - t0
+    # warm start from the converged x: the production per-step behavior
+    _, _, k_warm = solve(rhs, x)
+    k_warm = int(k_warm)
+
+    prof = {
+        k: round(s.profiler.totals[k] / max(s.profiler.counts[k], 1), 4)
+        for k in s.profiler.totals
+    }
+    return {
+        "cells_per_s": cells_s,
+        "wall_per_step_s": round(wall, 4),
+        "div_max": float(div_max),
+        "bicgstab_iters_to_tol": int(k_cold),
+        "bicgstab_iters_warm_restart": k_warm,
+        "bicgstab_iters_per_s": round(int(k2) / max(t_cold, 1e-9), 1),
+        "per_operator_mean_s": prof,
+        "n": n,
+    }
+
+
+def bench_tgv_iterative():
+    """256^3 Taylor-Green, full step with the iterative solver at the
+    reference tolerances (BASELINE config #3's resolution, uniform)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cup3d_tpu.grid.uniform import BC, UniformGrid
+    from cup3d_tpu.ops import krylov
+    from cup3d_tpu.ops.advection import rk3_step
+    from cup3d_tpu.ops.projection import project
+    from cup3d_tpu.utils.flows import taylor_green_3d
+
+    n = _scaled(256)
+    grid = UniformGrid((n, n, n), (2 * np.pi,) * 3, (BC.periodic,) * 3)
+    solver = krylov.build_iterative_solver(
+        grid, tol_abs=1e-6, tol_rel=1e-4
+    )
+
+    @jax.jit
+    def step(vel, dt, uinf):
+        # cold Poisson solve each step: measures the full BiCGSTAB cost
+        # (production drivers warm-start; the fish bench reflects that)
+        vel = rk3_step(grid, vel, dt, 1e-3, uinf)
+        vel, p = project(grid, vel, dt, solver)
+        return vel, p
+
+    vel = taylor_green_3d(grid)
+    dt = jnp.float32(1e-3)
+    uinf = jnp.zeros(3, jnp.float32)
+    for _ in range(2):
+        vel, p = step(vel, dt, uinf)
+    float(vel[0, 0, 0, 0])
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        vel, p = step(vel, dt, uinf)
+        # a scalar host read forces execution: block_until_ready alone is
+        # unreliable on the experimental TPU platform (chained dispatches
+        # report ready without running)
+        float(vel[0, 0, 0, 0])
+    wall = (time.perf_counter() - t0) / iters
+
+    from cup3d_tpu.ops import diagnostics as diag
+
+    _, div_max = diag.divergence_norms(grid, vel)
+    return {
+        "cells_per_s": n**3 / wall,
+        "wall_per_step_s": round(wall, 4),
+        "div_max": float(div_max),
+        "n": n,
+    }
+
+
+def bench_spectral():
+    """256^3 obstacle-free spectral-projection step (round-1 headline,
+    kept as the secondary fast-path number)."""
     import jax.numpy as jnp
 
     from cup3d_tpu.grid.uniform import BC, UniformGrid
     from cup3d_tpu.ops.poisson import build_spectral_solver
     from cup3d_tpu.sim.fused import make_step
-
-    n = int(os.environ.get("CUP3D_BENCH_N", "256"))  # override for CPU smoke
-    grid = UniformGrid((n, n, n), (2 * np.pi,) * 3, (BC.periodic,) * 3)
-    solver = build_spectral_solver(grid)
-    step = make_step(grid, nu=1e-3, solver=solver)
-
     from cup3d_tpu.utils.flows import taylor_green_2d
 
-    vel = taylor_green_2d(grid)  # built on device, no big host transfer
+    n = _scaled(256)
+    grid = UniformGrid((n, n, n), (2 * np.pi,) * 3, (BC.periodic,) * 3)
+    step = make_step(grid, nu=1e-3, solver=build_spectral_solver(grid))
+    vel = taylor_green_2d(grid)
     dt = jnp.float32(1e-3)
     uinf = jnp.zeros(3, jnp.float32)
-
-    for _ in range(3):  # warmup + compile
+    for _ in range(3):
         vel, p = step(vel, dt, uinf)
-    vel.block_until_ready()
-
+    float(vel[0, 0, 0, 0])
     iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         vel, p = step(vel, dt, uinf)
-    vel.block_until_ready()
-    elapsed = time.perf_counter() - t0
+        float(vel[0, 0, 0, 0])  # forced sync (see bench_tgv_iterative)
+    wall = (time.perf_counter() - t0) / iters
+    return {"cells_per_s": n**3 / wall, "wall_per_step_s": round(wall, 5),
+            "n": n}
 
-    cells_per_sec = n ** 3 * iters / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": f"cell-updates/sec ({n}^3 uniform NS step, RK3+projection)",
-                "value": round(cells_per_sec, 1),
-                "unit": "cells/s",
-                "vs_baseline": round(cells_per_sec / BASELINE_CELLS_PER_SEC, 3),
-            }
-        )
+
+def bench_two_fish_amr():
+    """The run.sh acceptance case (BASELINE config #4), levelMax=3: two
+    StefanFish on the dynamically adapting forest."""
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    level_max = int(os.environ.get("CUP3D_BENCH_AMR_LEVELS", "3"))
+    cfg = SimulationConfig(
+        bpdx=1, bpdy=1, bpdz=1, levelMax=level_max,
+        levelStart=level_max - 1, extent=1.0, CFL=0.4, Ctol=0.1, Rtol=5.0,
+        nu=1e-3, tend=0.0, nsteps=10**9, rampup=0,
+        poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+        factory_content=(
+            "StefanFish L=0.4 T=1.0 xpos=0.3 ypos=0.5 zpos=0.5 "
+            "planarAngle=180 heightProfile=danio widthProfile=stefan "
+            "bFixFrameOfRef=1\n"
+            "StefanFish L=0.4 T=1.0 xpos=0.7 ypos=0.5 zpos=0.5 "
+            "heightProfile=danio widthProfile=stefan"
+        ),
+        verbose=False, freqDiagnostics=0,
     )
+    sim = AMRSimulation(cfg)
+    sim.init()
+    iters = 6
+    wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=2,
+                       iters=iters)
+    total, div_max = sim._divnorms(sim.state["vel"])
+    nb = sim.grid.nb
+    return {
+        "wall_per_step_s": round(wall, 4),
+        "cells_per_s": nb * sim.grid.bs**3 / wall,
+        "blocks": int(nb),
+        "levels": level_max,
+        "div_max": float(div_max),
+    }
+
+
+def main():
+    which = os.environ.get("CUP3D_BENCH_CONFIG", "all")
+    if which not in ("fish", "tgv", "spectral", "amr", "all"):
+        print(json.dumps({"metric": "error", "value": 0, "unit": "",
+                          "vs_baseline": 0,
+                          "error": f"unknown CUP3D_BENCH_CONFIG {which!r}"}))
+        return
+    secondary = {}
+    fish = None
+    if which in ("fish", "all"):
+        fish = bench_fish_uniform()
+    # secondary configs are isolated: a platform fault in one is reported
+    # in place without losing the others
+    for key, fn in (
+        ("tgv_iterative", bench_tgv_iterative),
+        ("spectral", bench_spectral),
+        ("two_fish_amr", bench_two_fish_amr),
+    ):
+        sel = {"tgv_iterative": "tgv", "spectral": "spectral",
+               "two_fish_amr": "amr"}[key]
+        if which not in (sel, "all"):
+            continue
+        try:
+            secondary[key] = fn()
+        except Exception as e:  # pragma: no cover - platform dependent
+            secondary[key] = {"error": f"{type(e).__name__}: {e}"[:300],
+                              "cells_per_s": 0.0}
+
+    if fish is None:  # single-config run: promote it to the headline
+        key, data = next(iter(secondary.items()))
+        out = {
+            "metric": f"cell-updates/sec ({key})",
+            "value": round(data["cells_per_s"], 1),
+            "unit": "cells/s",
+            "vs_baseline": round(data["cells_per_s"] / BASELINE_CELLS_PER_SEC, 3),
+            "detail": data,
+        }
+    else:
+        n = fish.pop("n")
+        value = fish.pop("cells_per_s")
+        out = {
+            "metric": (
+                f"cell-updates/sec ({n}^3 uniform self-propelled fish, "
+                "full pipeline, iterative Poisson 1e-6/1e-4)"
+            ),
+            "value": round(value, 1),
+            "unit": "cells/s",
+            "vs_baseline": round(value / BASELINE_CELLS_PER_SEC, 3),
+            "fish": fish,
+        }
+    for k, v in secondary.items():
+        d = dict(v)
+        d["cells_per_s"] = round(d["cells_per_s"], 1)
+        out[k] = d
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -12,7 +12,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cup3d_tpu.models.base import force_integrals, momentum_integrals
+from cup3d_tpu.models.base import (
+    force_integrals,
+    momentum_integrals,
+    pack_forces,
+    pack_moments,
+    unpack_forces,
+    unpack_moments,
+)
 from cup3d_tpu.ops.penalization import penalize
 from cup3d_tpu.sim.data import SimulationData
 from cup3d_tpu.sim.operators import Operator
@@ -52,15 +59,19 @@ class UpdateObstacles(Operator):
 
     def __init__(self, sim: SimulationData):
         super().__init__(sim)
-        self._moments = jax.jit(partial(momentum_integrals, sim.grid))
+        # one packed vector per obstacle: a single host read per step
+        self._moments = jax.jit(
+            lambda chi, vel, cm: pack_moments(
+                momentum_integrals(sim.grid, chi, vel, cm)
+            )
+        )
 
     def __call__(self, dt):
         s = self.sim
         for ob in s.obstacles:
             m = self._moments(ob.chi, s.state["vel"],
                               jnp.asarray(ob.centerOfMass, s.dtype))
-            moments = {k: np.asarray(v, dtype=np.float64) for k, v in m.items()}
-            ob.compute_velocities(moments)
+            ob.compute_velocities(unpack_moments(m))
             ob.update(dt)
 
 
@@ -75,7 +86,7 @@ class Penalization(Operator):
         from cup3d_tpu.ops.chi import grad_chi
 
         self._gradchi = jax.jit(partial(grad_chi, sim.grid))
-        self._xc = sim.grid.cell_centers(sim.dtype)
+        self._xc = sim.xc  # device-cached centers (sim/data.py)
 
     def __call__(self, dt):
         s = self.sim
@@ -107,21 +118,27 @@ class ComputeForces(Operator):
 
     def __init__(self, sim: SimulationData):
         super().__init__(sim)
-        self._forces = jax.jit(partial(force_integrals, sim.grid, nu=sim.nu))
+        self._forces = jax.jit(
+            lambda chi, p, vel, cm, ubody: pack_forces(
+                force_integrals(sim.grid, chi, p, vel, sim.nu, cm, ubody)
+            )
+        )
 
     def __call__(self, dt):
         s = self.sim
         for i, ob in enumerate(s.obstacles):
-            f = self._forces(
-                chi=ob.chi, p=s.state["p"], vel=s.state["vel"],
-                cm=jnp.asarray(ob.centerOfMass, s.dtype),
-                ubody=ob.body_velocity_field(),
+            f = unpack_forces(
+                self._forces(
+                    ob.chi, s.state["p"], s.state["vel"],
+                    jnp.asarray(ob.centerOfMass, s.dtype),
+                    ob.body_velocity_field(),
+                )
             )
-            ob.pres_force = np.asarray(f["pres_force"], np.float64)
-            ob.visc_force = np.asarray(f["visc_force"], np.float64)
+            ob.pres_force = f["pres_force"]
+            ob.visc_force = f["visc_force"]
             ob.force = ob.pres_force + ob.visc_force
-            ob.torque = np.asarray(f["torque"], np.float64)
-            ob.pow_out = float(f["power"])
+            ob.torque = f["torque"]
+            ob.pow_out = f["power"]
             s.logger.write(
                 f"forces_{i}.txt",
                 f"{s.time:.8e} " + " ".join(f"{v:.8e}" for v in ob.force)

@@ -137,7 +137,9 @@ def build_spectral_solver(grid: UniformGrid, dtype=jnp.float32,
     inv[nz] = 1.0 / lam_flat[nz]
     inv = jnp.asarray(inv.reshape(lam.shape), dtype=dtype)
 
-    def solve(rhs: jnp.ndarray) -> jnp.ndarray:
+    def solve(rhs: jnp.ndarray, x0=None) -> jnp.ndarray:
+        # x0 accepted for interface parity with the iterative solver
+        # (warm starts are meaningless for an exact direct solve)
         f = rhs.astype(dtype)
         for a in range(3):
             f = _apply_mat(mats[a], f, a)

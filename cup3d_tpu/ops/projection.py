@@ -32,9 +32,12 @@ def pressure_rhs(grid: UniformGrid, u: jnp.ndarray, dt,
 
 def project(grid: UniformGrid, u: jnp.ndarray, dt, solver: Callable,
             chi: Optional[jnp.ndarray] = None,
-            udef: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (projected velocity, pressure)."""
+            udef: Optional[jnp.ndarray] = None,
+            p_init: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (projected velocity, pressure).  ``p_init`` warm-starts an
+    iterative solver from the previous step's pressure (ignored by the
+    exact spectral solver)."""
     rhs = pressure_rhs(grid, u, dt, chi, udef)
-    p = solver(rhs)
+    p = solver(rhs, p_init)
     gradp = st.grad(grid.pad_scalar(p, 1), 1, grid.h)
     return u - dt * gradp, p

@@ -35,7 +35,13 @@ from cup3d_tpu.grid.flux import build_flux_tables
 from cup3d_tpu.grid.octree import Octree, TreeConfig
 from cup3d_tpu.grid.uniform import BC
 from cup3d_tpu.io.logging import BufferedLogger, Profiler
-from cup3d_tpu.models.base import momentum_integrals_core
+from cup3d_tpu.models.base import (
+    momentum_integrals_core,
+    pack_forces,
+    pack_moments,
+    unpack_forces,
+    unpack_moments,
+)
 from cup3d_tpu.ops import amr_ops
 from cup3d_tpu.ops.chi import heaviside
 from cup3d_tpu.ops.penalization import penalize
@@ -154,8 +160,10 @@ class AMRSimulation:
         )
         self._penalize = jax.jit(penalize)
         self._forces = jax.jit(
-            lambda chi, p, vel, cm, ubody: amr_ops.force_integrals_blocks(
-                g, self._tab1, self._xc, chi, p, vel, self.nu, cm, ubody
+            lambda chi, p, vel, cm, ubody: pack_forces(
+                amr_ops.force_integrals_blocks(
+                    g, self._tab1, self._xc, chi, p, vel, self.nu, cm, ubody
+                )
             )
         )
         # per-obstacle rigid+deformation velocity field from the cached
@@ -197,7 +205,9 @@ class AMRSimulation:
         self._scores = jax.jit(scores)
 
         def moments(chi, vel, cm):
-            return momentum_integrals_core(self._xc, self._vol, chi, vel, cm)
+            return pack_moments(
+                momentum_integrals_core(self._xc, self._vol, chi, vel, cm)
+            )
 
         self._moments = jax.jit(moments)
 
@@ -391,9 +401,7 @@ class AMRSimulation:
                     m = self._moments(
                         ob.chi, s["vel"], jnp.asarray(ob.centerOfMass, self.dtype)
                     )
-                    ob.compute_velocities(
-                        {k: np.asarray(v, np.float64) for k, v in m.items()}
-                    )
+                    ob.compute_velocities(unpack_moments(m))
                     ob.update(dt)
             with self.profiler("Penalization"):
                 if len(self.obstacles) > 1:
@@ -455,16 +463,18 @@ class AMRSimulation:
         main.cpp:12496-12503, reduction 13079-13115)."""
         s = self.state
         for i, ob in enumerate(self.obstacles):
-            f = self._forces(
-                ob.chi, s["p"], s["vel"],
-                jnp.asarray(ob.centerOfMass, self.dtype),
-                self._obstacle_ubody(ob),
+            f = unpack_forces(
+                self._forces(
+                    ob.chi, s["p"], s["vel"],
+                    jnp.asarray(ob.centerOfMass, self.dtype),
+                    self._obstacle_ubody(ob),
+                )
             )
-            ob.pres_force = np.asarray(f["pres_force"], np.float64)
-            ob.visc_force = np.asarray(f["visc_force"], np.float64)
+            ob.pres_force = f["pres_force"]
+            ob.visc_force = f["visc_force"]
             ob.force = ob.pres_force + ob.visc_force
-            ob.torque = np.asarray(f["torque"], np.float64)
-            ob.pow_out = float(f["power"])
+            ob.torque = f["torque"]
+            ob.pow_out = f["power"]
             self.logger.write(
                 f"forces_{i}.txt",
                 f"{self.time:.8e} " + " ".join(f"{v:.8e}" for v in ob.force)

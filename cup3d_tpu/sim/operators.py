@@ -115,14 +115,18 @@ class PressureProjection(Operator):
         grid, solver = sim.grid, sim.poisson_solver
 
         @jax.jit
-        def _project(vel, chi, udef, dt):
-            return project(grid, vel, dt, solver, chi, udef)
+        def _project(vel, chi, udef, dt, p_old):
+            # previous pressure warm-starts the iterative solver
+            # (main.cpp:15087-15100); the spectral solver ignores it
+            return project(grid, vel, dt, solver, chi, udef, p_init=p_old)
 
         self._project = _project
 
     def __call__(self, dt):
         s = self.sim
-        vel, p = self._project(s.state["vel"], s.state["chi"], s.state["udef"], dt)
+        vel, p = self._project(
+            s.state["vel"], s.state["chi"], s.state["udef"], dt, s.state["p"]
+        )
         s.state["vel"] = vel
         s.state["p"] = p
 

@@ -1,0 +1,155 @@
+"""Quantitative accuracy of the halo lab's coarse-fine interpolation.
+
+The reference interpolates coarse-neighbor ghosts with 2nd-order tensor
+stencils (CoarseFineInterpolation, main.cpp:4236-4612).  Our lab is the
+same order but takes two documented corner shortcuts (grid/blocks.py:30-37):
+(a) scratch regions owned two levels finer average the middle fine octant;
+(b) regions two levels coarser use constant injection.  These tests put
+numbers on that design: quadratic exactness away from the shortcut cells,
+a measured bound on the shortcut error, and 2nd-order convergence of the
+ghost error for a smooth field under refinement.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup3d_tpu.grid.blocks import BlockGrid
+from cup3d_tpu.grid.octree import Octree, TreeConfig
+from cup3d_tpu.grid.uniform import BC
+
+BS = 8
+
+
+def _grid(refines, bpd=(2, 2, 2), lmax=3):
+    tree = Octree(TreeConfig(bpd, lmax, (True,) * 3), 0)
+    for k in refines:
+        tree.refine(k)
+    tree.assert_balanced()
+    return BlockGrid(tree, (1.0, 1.0, 1.0), (BC.periodic,) * 3)
+
+
+def _fill(grid, f):
+    return jnp.asarray(f(grid.cell_centers(np.float64)).astype(np.float32))
+
+
+def _ghost_errors(grid, width, f, interior_only=False):
+    """Max |lab ghost - exact f| over all ghosts of all blocks, split into
+    same/fine-sourced ghosts vs coarse-interpolated ghosts.
+
+    interior_only restricts to blocks whose halo (plus the coarse-scratch
+    stencil margin) cannot cross the periodic wrap — required when f is
+    not periodic-smooth (a global quadratic jumps at the wrap, and the
+    interpolation stencil legitimately reads across it)."""
+    tab = grid.lab_tables(width)
+    field = _fill(grid, f)
+    lab = np.asarray(tab.assemble_scalar(field, BS), np.float64)
+    gx, gy, gz = tab.ghost_xyz
+    mask_coarse = np.asarray(tab.mask_coarse)
+
+    # exact values at ghost physical positions (periodic domain)
+    bs = grid.bs
+    err_plain, err_coarse = 0.0, 0.0
+    for b in range(grid.nb):
+        if interior_only:
+            margin = 4 * 2 * grid.h[b]  # coarse-scratch reach, h_c = 2h
+            lo = grid.origin[b] - margin
+            hi = grid.origin[b] + bs * grid.h[b] + margin
+            if np.any(lo < 0) or np.any(hi > 1):
+                continue
+        pos = (
+            grid.origin[b]
+            + (np.stack([gx, gy, gz], -1) - width + 0.5) * grid.h[b]
+        )
+        pos = np.mod(pos, 1.0)
+        exact = f(pos)
+        got = lab[b, gx, gy, gz]
+        e = np.abs(got - exact)
+        mc = mask_coarse[b]
+        if np.any(~mc):
+            err_plain = max(err_plain, float(e[~mc].max()))
+        if np.any(mc):
+            err_coarse = max(err_coarse, float(e[mc].max()))
+    return err_plain, err_coarse
+
+
+def test_quadratic_one_level():
+    """Single-level jumps, interior blocks of a global quadratic:
+
+    - linear part is reproduced exactly (restriction and prolongation are
+      both exact for linears);
+    - quadratic part carries only the O(h^2) cell-average offset that 2:1
+      restriction (mean of 8 subcells vs center value, h^2/16 per axis)
+      introduces — the same offset as the reference's AverageDownAndFill
+      (main.cpp:1832-1905).  Measured ~1.5e-5 at h_f = 1/64; gate 5e-5."""
+
+    def fquad(x):
+        return (
+            0.3 * x[..., 0] ** 2
+            - 0.2 * x[..., 1] ** 2
+            + 0.15 * x[..., 2] ** 2
+            + 0.1 * x[..., 0]
+            + 0.05
+        )
+
+    def flin(x):
+        return 0.3 * x[..., 0] - 0.2 * x[..., 1] + 0.1 * x[..., 2] + 0.05
+
+    # interior refined octet on a 4^3 base: no stencil crosses the wrap
+    g = _grid([(0, 1, 1, 1)], bpd=(4, 4, 4))
+    err_plain, err_coarse = _ghost_errors(g, 1, flin, interior_only=True)
+    assert err_plain < 2e-6 and err_coarse < 2e-6
+    err_plain, err_coarse = _ghost_errors(g, 1, fquad, interior_only=True)
+    assert err_plain < 5e-5
+    assert err_coarse < 5e-5
+
+
+def test_corner_shortcut_error_bounded():
+    """Two-level configurations exercise the documented corner shortcuts;
+    the added ghost error must stay bounded by the interpolation's own
+    truncation scale (measured here, documented in grid/blocks.py)."""
+
+    def f(x):
+        return np.sin(2 * np.pi * x[..., 0]) * np.cos(
+            2 * np.pi * x[..., 1]
+        ) * np.sin(2 * np.pi * x[..., 2] + 0.3)
+
+    # balanced three-level mesh: 27 refined octets with a deep interior
+    # octet -> levels 0, 1, 2 all meet within a halo's reach
+    refines = [(0, i, j, k) for i in (1, 2, 3) for j in (1, 2, 3)
+               for k in (1, 2, 3)] + [(1, 5, 5, 5)]
+    g = _grid(refines, bpd=(4, 4, 4), lmax=3)
+    for width in (1, 3):
+        err_plain, err_coarse = _ghost_errors(g, width, f)
+        # h_coarse = 1/32 here: 2nd-order scale ~ (2 pi h_c)^2/8 ~ 5e-3
+        assert err_plain < 5e-3, f"width {width}: plain {err_plain}"
+        assert err_coarse < 2e-2, f"width {width}: coarse {err_coarse}"
+
+
+@pytest.mark.parametrize("width", [1, 3])
+def test_ghost_error_second_order_convergence(width):
+    """Smooth-field ghost error drops ~4x when every block is refined one
+    level (mesh halved): the interpolation is genuinely 2nd order, corner
+    shortcuts included."""
+
+    def f(x):
+        return np.sin(2 * np.pi * x[..., 0]) * np.cos(
+            2 * np.pi * x[..., 1]
+        ) * np.sin(2 * np.pi * x[..., 2] + 0.3)
+
+    def max_err(bpd, refines, lmax):
+        g = _grid(refines, bpd=bpd, lmax=lmax)
+        ep, ec = _ghost_errors(g, width, f)
+        return max(ep, ec)
+
+    # geometrically identical three-level topology at h and h/2
+    ref_h = [(0, i, j, k) for i in (1, 2, 3) for j in (1, 2, 3)
+             for k in (1, 2, 3)] + [(1, 5, 5, 5)]
+    ref_h2 = [(0, i, j, k) for i in range(2, 8) for j in range(2, 8)
+              for k in range(2, 8)] + [
+        (1, i, j, k) for i in (10, 11) for j in (10, 11) for k in (10, 11)
+    ]
+    e_h = max_err((4, 4, 4), ref_h, 3)
+    e_h2 = max_err((8, 8, 8), ref_h2, 3)
+    rate = np.log2(e_h / e_h2)
+    assert rate > 1.6, f"convergence rate {rate:.2f} (errors {e_h:.3e} -> {e_h2:.3e})"
