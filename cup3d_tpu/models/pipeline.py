@@ -59,19 +59,27 @@ class UpdateObstacles(Operator):
 
     def __init__(self, sim: SimulationData):
         super().__init__(sim)
-        # one packed vector per obstacle: a single host read per step
+        # ALL obstacles' moments in one (n_obs, 19) host read per step
         self._moments = jax.jit(
-            lambda chi, vel, cm: pack_moments(
-                momentum_integrals(sim.grid, chi, vel, cm)
+            lambda chis, vel, cms: jnp.stack(
+                [
+                    pack_moments(momentum_integrals(sim.grid, c, vel, cms[i]))
+                    for i, c in enumerate(chis)
+                ]
             )
         )
 
     def __call__(self, dt):
         s = self.sim
-        for ob in s.obstacles:
-            m = self._moments(ob.chi, s.state["vel"],
-                              jnp.asarray(ob.centerOfMass, s.dtype))
-            ob.compute_velocities(unpack_moments(m))
+        cms = jnp.asarray(
+            np.stack([ob.centerOfMass for ob in s.obstacles]), s.dtype
+        )
+        M = np.asarray(
+            self._moments(tuple(ob.chi for ob in s.obstacles),
+                          s.state["vel"], cms)
+        )
+        for ob, row in zip(s.obstacles, M):
+            ob.compute_velocities(unpack_moments(row))
             ob.update(dt)
 
 
@@ -118,22 +126,33 @@ class ComputeForces(Operator):
 
     def __init__(self, sim: SimulationData):
         super().__init__(sim)
+        # ALL obstacles' force QoI in one (n_obs, 10) host read per step
         self._forces = jax.jit(
-            lambda chi, p, vel, cm, ubody: pack_forces(
-                force_integrals(sim.grid, chi, p, vel, sim.nu, cm, ubody)
+            lambda chis, p, vel, cms, ubodies: jnp.stack(
+                [
+                    pack_forces(
+                        force_integrals(sim.grid, c, p, vel, sim.nu,
+                                        cms[i], ubodies[i])
+                    )
+                    for i, c in enumerate(chis)
+                ]
             )
         )
 
     def __call__(self, dt):
         s = self.sim
-        for i, ob in enumerate(s.obstacles):
-            f = unpack_forces(
-                self._forces(
-                    ob.chi, s.state["p"], s.state["vel"],
-                    jnp.asarray(ob.centerOfMass, s.dtype),
-                    ob.body_velocity_field(),
-                )
+        cms = jnp.asarray(
+            np.stack([ob.centerOfMass for ob in s.obstacles]), s.dtype
+        )
+        F = np.asarray(
+            self._forces(
+                tuple(ob.chi for ob in s.obstacles), s.state["p"],
+                s.state["vel"], cms,
+                tuple(ob.body_velocity_field() for ob in s.obstacles),
             )
+        )
+        for i, (ob, row) in enumerate(zip(s.obstacles, F)):
+            f = unpack_forces(row)
             ob.pres_force = f["pres_force"]
             ob.visc_force = f["visc_force"]
             ob.force = ob.pres_force + ob.visc_force

@@ -1,0 +1,141 @@
+"""Pallas TPU kernel for the getZ block preconditioner.
+
+``krylov.block_cg_tiles`` runs a fixed-iteration CG on every 8^3 tile
+independently (the reference's poisson/diffusion getZ kernels,
+main.cpp:14617-14746, 10448-10580).  Expressed in jnp, every CG iteration
+materializes several full-size temporaries to HBM — ~24 HBM passes per
+preconditioner application, measured at ~3% of HBM peak on a v5e.
+
+This kernel keeps the whole CG in VMEM: HBM traffic is read b once, write
+z once.  Layout: tiles are transposed to ``(8, 8, 8, T)`` so the *batch*
+of tiles rides the 128-wide lane dimension — every (i, j, k) cell is a
+T-vector processed fully vectorized, and the zero-Dirichlet 7-point
+Laplacian becomes shifted adds over the three leading (sublane) axes.
+Per-tile CG scalars (alpha, beta, residual norms) are (1,1,1,T) lane
+vectors.
+
+``krylov.block_cg_tiles`` is the public entry and dispatches here on TPU
+(via ``use_pallas``); tests call ``block_cg_tiles_fast(interpret=True)``
+for bit-level parity with the jnp reference on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TILE_T = 256  # tiles per kernel instance: ~6 VMEM arrays * 512 KB
+
+
+def _cg_kernel(b_ref, shift_ref, z_ref, *, iters: int):
+    b = b_ref[...]
+    shift = shift_ref[...]  # (1, 1, 1, T), broadcasts over cells
+    zero_plane = jnp.zeros_like(b[:1])
+
+    def lap(p):
+        out = -6.0 * p
+        # zero-Dirichlet neighbor sums along the three leading axes
+        out += jnp.concatenate([p[1:], zero_plane], axis=0)
+        out += jnp.concatenate([zero_plane, p[:-1]], axis=0)
+        zy = jnp.zeros_like(p[:, :1])
+        out += jnp.concatenate([p[:, 1:], zy], axis=1)
+        out += jnp.concatenate([zy, p[:, :-1]], axis=1)
+        zz = jnp.zeros_like(p[:, :, :1])
+        out += jnp.concatenate([p[:, :, 1:], zz], axis=2)
+        out += jnp.concatenate([zz, p[:, :, :-1]], axis=2)
+        return out
+
+    def dot(a, c):
+        return jnp.sum(a * c, axis=(0, 1, 2), keepdims=True)
+
+    z0 = jnp.zeros_like(b)
+    rs0 = dot(b, b)
+
+    def body(_, carry):
+        z, res, p, rs = carry
+        ap = -lap(p) + shift * p
+        denom = dot(p, ap)
+        ok = jnp.abs(denom) > 1e-30
+        alpha = jnp.where(ok, rs / jnp.where(ok, denom, 1.0), 0.0)
+        z = z + alpha * p
+        res = res - alpha * ap
+        rs_new = dot(res, res)
+        okr = rs > 1e-30
+        beta = jnp.where(okr, rs_new / jnp.where(okr, rs, 1.0), 0.0)
+        p = res + beta * p
+        return z, res, p, rs_new
+
+    z, _, _, _ = jax.lax.fori_loop(0, iters, body, (z0, b, b, rs0))
+    z_ref[...] = z
+
+
+@partial(jax.jit, static_argnames=("iters", "interpret"))
+def _cg_tiles_pallas(bt: jnp.ndarray, shift_t: jnp.ndarray, iters: int,
+                     interpret: bool = False) -> jnp.ndarray:
+    """bt: (bs, bs, bs, n_pad) batch-last tiles; shift_t: (1, 1, 1, n_pad)."""
+    from jax.experimental import pallas as pl
+
+    bs = bt.shape[0]
+    n = bt.shape[-1]
+    T = min(TILE_T, n)
+    grid = (n // T,)
+    spec = pl.BlockSpec((bs, bs, bs, T), lambda i: (0, 0, 0, i))
+    sspec = pl.BlockSpec((1, 1, 1, T), lambda i: (0, 0, 0, i))
+    return pl.pallas_call(
+        partial(_cg_kernel, iters=iters),
+        out_shape=jax.ShapeDtypeStruct(bt.shape, bt.dtype),
+        grid=grid,
+        in_specs=[spec, sspec],
+        out_specs=spec,
+        interpret=interpret,
+    )(bt, shift_t)
+
+
+def use_pallas() -> bool:
+    if os.environ.get("CUP3D_NO_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def block_cg_tiles_fast(b: jnp.ndarray, iters: int, shift=0.0,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Solve (-block_lap + shift) z = b per trailing-8^3 tile, forcing the
+    Pallas path (interpret=True runs it on CPU for parity tests)."""
+    if not (use_pallas() or interpret):
+        from cup3d_tpu.ops.krylov import block_cg_tiles_reference
+
+        return block_cg_tiles_reference(b, iters, shift)
+    return block_cg_tiles_pallas(b, iters, shift, interpret)
+
+
+def block_cg_tiles_pallas(b: jnp.ndarray, iters: int, shift=0.0,
+                          interpret: bool = False) -> jnp.ndarray:
+    bs = b.shape[-1]
+    lead = b.shape[:-3]
+    n = int(np.prod(lead)) if lead else 1
+    bt = jnp.moveaxis(b.reshape((n,) + b.shape[-3:]), 0, -1)  # (bs,bs,bs,n)
+
+    shift_arr = jnp.asarray(shift, b.dtype)
+    if shift_arr.ndim == 0:
+        shift_vec = jnp.full((1, 1, 1, n), shift_arr, b.dtype)
+    else:
+        # per-tile scalar (e.g. (nb,1,1,1) block h^2): one value per tile
+        sv = jnp.broadcast_to(shift_arr, lead + (1, 1, 1)).reshape(n)
+        shift_vec = sv.reshape(1, 1, 1, n)
+
+    T = min(TILE_T, max(n, 1))
+    n_pad = -(-n // T) * T
+    if n_pad != n:
+        bt = jnp.concatenate(
+            [bt, jnp.zeros(b.shape[-3:] + (n_pad - n,), b.dtype)], axis=-1
+        )
+        shift_vec = jnp.concatenate(
+            [shift_vec, jnp.zeros((1, 1, 1, n_pad - n), b.dtype)], axis=-1
+        )
+    zt = _cg_tiles_pallas(bt, shift_vec, iters, interpret)
+    z = jnp.moveaxis(zt[..., :n], -1, 0).reshape(b.shape)
+    return z

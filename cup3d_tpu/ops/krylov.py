@@ -109,11 +109,26 @@ def block_cg_tiles(b: jnp.ndarray, iters: int, shift=0.0) -> jnp.ndarray:
     trailing-bs^3 tile of ``b`` (shape (..., bs, bs, bs)) with `iters` CG
     steps — the batched getZ kernel (kernelPoissonGetZInner,
     main.cpp:14651-14702; the shifted variant is the diffusion getZ with
-    coefficient -6 - h^2/(nu dt), main.cpp:10571).  The tile operator with
-    its implicit zero-Dirichlet halo is SPD for shift >= 0, so plain CG
-    applies; the fixed iteration count keeps the graph static and every
-    tile equally expensive (no block imbalance).  ``shift`` may be a
-    traced scalar or an array broadcastable to ``b`` (per-block h^2)."""
+    coefficient -6 - h^2/(nu dt), main.cpp:10571).
+
+    On TPU this dispatches to the VMEM-resident Pallas kernel
+    (ops/getz_pallas.py, ~3x per application); elsewhere (and in tests)
+    it runs the jnp reference below."""
+    from cup3d_tpu.ops import getz_pallas
+
+    if getz_pallas.use_pallas():
+        return getz_pallas.block_cg_tiles_pallas(b, iters, shift)
+    return block_cg_tiles_reference(b, iters, shift)
+
+
+def block_cg_tiles_reference(b: jnp.ndarray, iters: int, shift=0.0) -> jnp.ndarray:
+    """Pure-jnp getZ (the ground truth the Pallas kernel is tested
+    against — the reference's own optimized-vs-reference kernel pattern,
+    main.cpp:9186-9190).  The tile operator with its implicit
+    zero-Dirichlet halo is SPD for shift >= 0, so plain CG applies; the
+    fixed iteration count keeps the graph static and every tile equally
+    expensive (no block imbalance).  ``shift`` may be a traced scalar or
+    an array broadcastable to ``b`` (per-block h^2)."""
     acc = jnp.promote_types(b.dtype, jnp.float32)
     bdot = lambda a, c: jnp.sum(
         a * c, axis=(-1, -2, -3), keepdims=True, dtype=acc

@@ -159,11 +159,18 @@ class AMRSimulation:
             )
         )
         self._penalize = jax.jit(penalize)
+        # ALL obstacles' force QoI in one (n_obs, 10) host read per step
         self._forces = jax.jit(
-            lambda chi, p, vel, cm, ubody: pack_forces(
-                amr_ops.force_integrals_blocks(
-                    g, self._tab1, self._xc, chi, p, vel, self.nu, cm, ubody
-                )
+            lambda chis, p, vel, cms, ubodies: jnp.stack(
+                [
+                    pack_forces(
+                        amr_ops.force_integrals_blocks(
+                            g, self._tab1, self._xc, c, p, vel, self.nu,
+                            cms[i], ubodies[i]
+                        )
+                    )
+                    for i, c in enumerate(chis)
+                ]
             )
         )
         # per-obstacle rigid+deformation velocity field from the cached
@@ -204,9 +211,17 @@ class AMRSimulation:
 
         self._scores = jax.jit(scores)
 
-        def moments(chi, vel, cm):
-            return pack_moments(
-                momentum_integrals_core(self._xc, self._vol, chi, vel, cm)
+        def moments(chis, vel, cms):
+            # one (n_obs, 19) transfer for all obstacles
+            return jnp.stack(
+                [
+                    pack_moments(
+                        momentum_integrals_core(
+                            self._xc, self._vol, c, vel, cms[i]
+                        )
+                    )
+                    for i, c in enumerate(chis)
+                ]
             )
 
         self._moments = jax.jit(moments)
@@ -397,11 +412,17 @@ class AMRSimulation:
             s["vel"] = self._advdiff(s["vel"], dt_j, uinf)
         if self.obstacles:
             with self.profiler("UpdateObstacles"):
-                for ob in self.obstacles:
-                    m = self._moments(
-                        ob.chi, s["vel"], jnp.asarray(ob.centerOfMass, self.dtype)
+                cms = jnp.asarray(
+                    np.stack([ob.centerOfMass for ob in self.obstacles]),
+                    self.dtype,
+                )
+                M = np.asarray(
+                    self._moments(
+                        tuple(ob.chi for ob in self.obstacles), s["vel"], cms
                     )
-                    ob.compute_velocities(unpack_moments(m))
+                )
+                for ob, row in zip(self.obstacles, M):
+                    ob.compute_velocities(unpack_moments(row))
                     ob.update(dt)
             with self.profiler("Penalization"):
                 if len(self.obstacles) > 1:
@@ -462,14 +483,17 @@ class AMRSimulation:
         """Per-obstacle force/torque/power QoI (reference ComputeForces,
         main.cpp:12496-12503, reduction 13079-13115)."""
         s = self.state
-        for i, ob in enumerate(self.obstacles):
-            f = unpack_forces(
-                self._forces(
-                    ob.chi, s["p"], s["vel"],
-                    jnp.asarray(ob.centerOfMass, self.dtype),
-                    self._obstacle_ubody(ob),
-                )
+        cms = jnp.asarray(
+            np.stack([ob.centerOfMass for ob in self.obstacles]), self.dtype
+        )
+        F = np.asarray(
+            self._forces(
+                tuple(ob.chi for ob in self.obstacles), s["p"], s["vel"],
+                cms, tuple(self._obstacle_ubody(ob) for ob in self.obstacles),
             )
+        )
+        for i, (ob, row) in enumerate(zip(self.obstacles, F)):
+            f = unpack_forces(row)
             ob.pres_force = f["pres_force"]
             ob.visc_force = f["visc_force"]
             ob.force = ob.pres_force + ob.visc_force
