@@ -290,6 +290,7 @@ def main():
             "vs_baseline": round(
                 data.get("cells_per_s", 0.0) / BASELINE_CELLS_PER_SEC, 3
             ),
+            "detail": data,
         }
         secondary.pop(key, None)
     else:

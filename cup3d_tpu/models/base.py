@@ -24,6 +24,7 @@ import numpy as np
 
 from cup3d_tpu.grid.uniform import UniformGrid
 from cup3d_tpu.ops.chi import grad_chi, heaviside
+from cup3d_tpu.ops.diagnostics import swim_split
 
 
 def quat_to_rot(q: np.ndarray) -> np.ndarray:
@@ -230,7 +231,8 @@ class Obstacle:
 # main.cpp:13783)
 
 _MOMENT_KEYS = ("mass", "center", "lin_mom", "ang_mom", "inertia")
-_FORCE_KEYS = ("pres_force", "visc_force", "torque", "power")
+_FORCE_KEYS = ("pres_force", "visc_force", "torque", "power", "thrust",
+               "drag", "def_power")
 
 
 def pack_moments(m: Dict[str, jnp.ndarray]) -> jnp.ndarray:
@@ -250,8 +252,10 @@ def unpack_moments(a) -> Dict[str, np.ndarray]:
 
 
 def pack_forces(f: Dict[str, jnp.ndarray]) -> jnp.ndarray:
-    """Force-integral dict -> (10,) device vector."""
-    return jnp.concatenate([jnp.reshape(f[k], (-1,)) for k in _FORCE_KEYS])
+    """Force-integral dict -> (13,) device vector."""
+    return jnp.concatenate(
+        [jnp.reshape(jnp.asarray(f[k]), (-1,)) for k in _FORCE_KEYS]
+    )
 
 
 def unpack_forces(a) -> Dict[str, np.ndarray]:
@@ -261,7 +265,22 @@ def unpack_forces(a) -> Dict[str, np.ndarray]:
         "visc_force": a[3:6],
         "torque": a[6:9],
         "power": float(a[9]),
+        "thrust": float(a[10]),
+        "drag": float(a[11]),
+        "def_power": float(a[12]),
     }
+
+
+def derived_force_qoi(f: Dict[str, np.ndarray], trans_vel: np.ndarray,
+                      eps: float = 1e-21) -> Dict[str, float]:
+    """Host-side derived swimming QoI (reference computeForces tail,
+    main.cpp:13098-13114): thrust/drag powers and deformation efficiency."""
+    vnorm = float(np.linalg.norm(trans_vel))
+    pthrust = f["thrust"] * vnorm
+    pdrag = f["drag"] * vnorm
+    def_power = f["def_power"]
+    eff = pthrust / (pthrust - min(def_power, 0.0) + eps)
+    return {"Pthrust": pthrust, "Pdrag": pdrag, "EffPDef": eff}
 
 
 def momentum_integrals_core(x: jnp.ndarray, vol, chi: jnp.ndarray,
@@ -295,7 +314,9 @@ def momentum_integrals(grid: UniformGrid, chi: jnp.ndarray, vel: jnp.ndarray,
 
 def force_integrals(grid: UniformGrid, chi: jnp.ndarray, p: jnp.ndarray,
                     vel: jnp.ndarray, nu: float, cm: jnp.ndarray,
-                    ubody: jnp.ndarray):
+                    ubody: jnp.ndarray,
+                    udef: Optional[jnp.ndarray] = None,
+                    vel_unit: Optional[jnp.ndarray] = None):
     """Surface tractions via the chi-gradient surface measure.
 
     With n_hat the outward normal and delta the surface density,
@@ -304,6 +325,11 @@ def force_integrals(grid: UniformGrid, chi: jnp.ndarray, p: jnp.ndarray,
       F_pres = integral(-p n_hat) dS      = sum  p * grad_chi * h^3
       F_visc = integral(2 nu S . n_hat)dS = sum -2 nu S . grad_chi * h^3
       power  = integral(traction . u_body) dS
+
+    The swimming split follows the reference per point
+    (main.cpp:12476-12485): forcePar = traction . vel_unit, thrust sums
+    its positive part, drag its negative part, and def_power is
+    traction . u_def (deformation power).
 
     Reference: ComputeForces probes one-sided stencils at surface points
     (main.cpp:12250-12494); the dense formulation trades its 5h-outside
@@ -335,4 +361,34 @@ def force_integrals(grid: UniformGrid, chi: jnp.ndarray, p: jnp.ndarray,
     torque = jnp.einsum("xyzc->c", jnp.cross(r, traction)) * h3
     power = jnp.sum(traction * ubody) * h3
     return {"pres_force": fpres, "visc_force": fvisc, "torque": torque,
-            "power": power}
+            "power": power,
+            **swim_split(traction, h3, udef, vel_unit)}
+
+
+def vel_unit(v: np.ndarray) -> np.ndarray:
+    n = np.linalg.norm(v)
+    return v / n if n > 1e-21 else np.zeros(3)
+
+
+def store_force_qoi(ob, f: Dict[str, np.ndarray]) -> None:
+    """Unpacked force vector -> obstacle attributes incl. the derived
+    swimming QoI (reference computeForces tail, main.cpp:13098-13114)."""
+    ob.pres_force = f["pres_force"]
+    ob.visc_force = f["visc_force"]
+    ob.force = ob.pres_force + ob.visc_force
+    ob.torque = f["torque"]
+    ob.pow_out = f["power"]
+    ob.thrust = f["thrust"]
+    ob.drag = f["drag"]
+    ob.def_power = f["def_power"]
+    d = derived_force_qoi(f, ob.transVel)
+    ob.Pthrust, ob.Pdrag, ob.EffPDef = d["Pthrust"], d["Pdrag"], d["EffPDef"]
+
+
+def log_forces(logger, i: int, time: float, ob) -> None:
+    logger.write(
+        f"forces_{i}.txt",
+        f"{time:.8e} " + " ".join(f"{v:.8e}" for v in ob.force)
+        + f" {ob.pow_out:.8e} {ob.thrust:.8e} {ob.drag:.8e}"
+        + f" {ob.def_power:.8e} {ob.EffPDef:.8e}\n",
+    )

@@ -14,11 +14,14 @@ import numpy as np
 
 from cup3d_tpu.models.base import (
     force_integrals,
+    log_forces,
     momentum_integrals,
     pack_forces,
     pack_moments,
+    store_force_qoi,
     unpack_forces,
     unpack_moments,
+    vel_unit,
 )
 from cup3d_tpu.ops.penalization import penalize
 from cup3d_tpu.sim.data import SimulationData
@@ -126,13 +129,14 @@ class ComputeForces(Operator):
 
     def __init__(self, sim: SimulationData):
         super().__init__(sim)
-        # ALL obstacles' force QoI in one (n_obs, 10) host read per step
+        # ALL obstacles' force QoI in one (n_obs, 13) host read per step
         self._forces = jax.jit(
-            lambda chis, p, vel, cms, ubodies: jnp.stack(
+            lambda chis, p, vel, cms, ubodies, udefs, vunits: jnp.stack(
                 [
                     pack_forces(
                         force_integrals(sim.grid, c, p, vel, sim.nu,
-                                        cms[i], ubodies[i])
+                                        cms[i], ubodies[i], udefs[i],
+                                        vunits[i])
                     )
                     for i, c in enumerate(chis)
                 ]
@@ -144,22 +148,18 @@ class ComputeForces(Operator):
         cms = jnp.asarray(
             np.stack([ob.centerOfMass for ob in s.obstacles]), s.dtype
         )
+        vunits = jnp.asarray(
+            np.stack([vel_unit(ob.transVel) for ob in s.obstacles]), s.dtype
+        )
         F = np.asarray(
             self._forces(
                 tuple(ob.chi for ob in s.obstacles), s.state["p"],
                 s.state["vel"], cms,
                 tuple(ob.body_velocity_field() for ob in s.obstacles),
+                tuple(ob.udef for ob in s.obstacles), vunits,
             )
         )
         for i, (ob, row) in enumerate(zip(s.obstacles, F)):
-            f = unpack_forces(row)
-            ob.pres_force = f["pres_force"]
-            ob.visc_force = f["visc_force"]
-            ob.force = ob.pres_force + ob.visc_force
-            ob.torque = f["torque"]
-            ob.pow_out = f["power"]
-            s.logger.write(
-                f"forces_{i}.txt",
-                f"{s.time:.8e} " + " ".join(f"{v:.8e}" for v in ob.force)
-                + f" {ob.pow_out:.8e}\n",
-            )
+            store_force_qoi(ob, unpack_forces(row))
+            log_forces(s.logger, i, s.time, ob)
+

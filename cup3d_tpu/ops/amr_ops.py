@@ -409,6 +409,8 @@ def force_integrals_blocks(
     nu: float,
     cm: jnp.ndarray,
     ubody: jnp.ndarray,
+    udef: Optional[jnp.ndarray] = None,
+    vel_unit: Optional[jnp.ndarray] = None,
 ):
     """Surface tractions via the chi-gradient surface measure, per-block h.
 
@@ -438,8 +440,11 @@ def force_integrals_blocks(
     r = xc - cm
     torque = jnp.sum(jnp.cross(r, traction) * vol[..., None], axis=(0, 1, 2, 3))
     power = jnp.sum(traction * ubody * vol[..., None])
+    from cup3d_tpu.ops.diagnostics import swim_split
+
     return {"pres_force": fpres, "visc_force": fvisc, "torque": torque,
-            "power": power}
+            "power": power,
+            **swim_split(traction, vol, udef, vel_unit)}
 
 
 def divergence_norms_blocks(grid: BlockGrid, vel: jnp.ndarray, tab: LabTables):

@@ -73,3 +73,22 @@ def dissipation(grid: UniformGrid, u: jnp.ndarray, nu: float) -> Dict[str, jnp.n
         "enstrophy": 0.5 * jnp.sum(jnp.sum(w * w, axis=-1)) * vol,
         "dissipation_rate": 2.0 * nu * jnp.sum(ss) * vol,
     }
+
+
+def swim_split(traction, vol, udef, vel_unit):
+    """thrust/drag/def_power from a per-cell traction band (reference
+    per-surface-point split, main.cpp:12476-12485): forcePar is the
+    traction component along the swimming direction; thrust sums its
+    positive part, drag its negative part, def_power is traction . u_def.
+    Layout-agnostic (dense uniform or block batch); vol broadcasts."""
+    if vel_unit is None:
+        z = jnp.zeros((), traction.dtype)
+        return {"thrust": z, "drag": z, "def_power": z}
+    force_par = jnp.einsum("...c,c->...", traction, vel_unit)
+    thrust = jnp.sum(jnp.maximum(force_par, 0.0) * vol)
+    drag = -jnp.sum(jnp.minimum(force_par, 0.0) * vol)
+    if udef is None:
+        def_power = jnp.zeros((), traction.dtype)
+    else:
+        def_power = jnp.sum(jnp.sum(traction * udef, axis=-1) * vol)
+    return {"thrust": thrust, "drag": drag, "def_power": def_power}

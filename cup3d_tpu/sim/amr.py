@@ -52,12 +52,6 @@ _EPS = 1e-6
 
 class AMRSimulation:
     def __init__(self, cfg: SimulationConfig, tree: Optional[Octree] = None):
-        if cfg.bFixMassFlux:
-            raise NotImplementedError(
-                "bFixMassFlux is only implemented on the uniform driver "
-                "(sim/operators.py FixMassFlux); the AMR profile-rescale "
-                "variant (main.cpp:12199-12249) is not wired yet"
-            )
         self.cfg = cfg
         self.dtype = jnp.dtype(cfg.dtype)
         periodic = tuple(b == "periodic" for b in cfg.bc)
@@ -159,14 +153,14 @@ class AMRSimulation:
             )
         )
         self._penalize = jax.jit(penalize)
-        # ALL obstacles' force QoI in one (n_obs, 10) host read per step
+        # ALL obstacles' force QoI in one (n_obs, 13) host read per step
         self._forces = jax.jit(
-            lambda chis, p, vel, cms, ubodies: jnp.stack(
+            lambda chis, p, vel, cms, ubodies, udefs, vunits: jnp.stack(
                 [
                     pack_forces(
                         amr_ops.force_integrals_blocks(
                             g, self._tab1, self._xc, c, p, vel, self.nu,
-                            cms[i], ubodies[i]
+                            cms[i], ubodies[i], udefs[i], vunits[i]
                         )
                     )
                     for i, c in enumerate(chis)
@@ -230,6 +224,27 @@ class AMRSimulation:
             return jnp.max(jnp.abs(vel + uinf))
 
         self._maxu = jax.jit(maxu)
+
+        if cfg.bFixMassFlux:
+            # FixMassFlux on the forest (reference avgUx_nonUniform +
+            # parabolic add, main.cpp:12199-12249): volume-weighted mean of
+            # u+uinf, then u += delta * 6 eta(1-eta) (exact restoration;
+            # see sim/operators.py FixMassFlux for the documented
+            # divergence from the reference's 6x-amplifying constant)
+            vol_total = float(np.sum(g.h**3) * g.bs**3)
+            eta = jnp.asarray(
+                (self._xc[..., 1] / g.extent[1]), self.dtype
+            )
+            profile = 6.0 * eta * (1.0 - eta)
+
+            def fix_flux(vel, uinf_x, u_target):
+                u_msr = (
+                    jnp.sum((vel[..., 0] + uinf_x) * self._vol) / vol_total
+                )
+                delta = u_target - u_msr
+                return vel.at[..., 0].add(delta * profile), u_msr
+
+            self._fix_flux = jax.jit(fix_flux)
 
     # -- obstacles ---------------------------------------------------------
 
@@ -441,7 +456,10 @@ class AMRSimulation:
                     s["vel"], s["chi"], self._body_velocity(),
                     jnp.asarray(self.lambda_penal, self.dtype), dt_j,
                 )
-        if self.cfg.uMax_forced > 0:  # bFixMassFlux rejected in __init__
+        if self.cfg.bFixMassFlux:
+            with self.profiler("FixMassFlux"):
+                self._fix_mass_flux()
+        elif self.cfg.uMax_forced > 0:
             # constant streamwise acceleration (ExternalForcing,
             # main.cpp:10581-10596)
             H = self.grid.extent[1]
@@ -479,31 +497,47 @@ class AMRSimulation:
         self.step_idx += 1
         self.time += dt
 
+    def _fix_mass_flux(self):
+        u_target = 2.0 / 3.0 * self.cfg.uMax_forced
+        vel, u_msr = self._fix_flux(
+            self.state["vel"],
+            jnp.asarray(self.uinf[0], self.dtype),
+            jnp.asarray(u_target, self.dtype),
+        )
+        self.state["vel"] = vel
+        self.logger.write(
+            "flux.txt",
+            f"{self.step_idx} {self.time:.8e} {float(u_msr):.8e}"
+            f" {u_target:.8e}\n",
+        )
+
     def _compute_forces(self):
         """Per-obstacle force/torque/power QoI (reference ComputeForces,
         main.cpp:12496-12503, reduction 13079-13115)."""
         s = self.state
+        from cup3d_tpu.models.base import (
+            log_forces,
+            store_force_qoi,
+            vel_unit,
+        )
+
         cms = jnp.asarray(
             np.stack([ob.centerOfMass for ob in self.obstacles]), self.dtype
+        )
+        vunits = jnp.asarray(
+            np.stack([vel_unit(ob.transVel) for ob in self.obstacles]),
+            self.dtype,
         )
         F = np.asarray(
             self._forces(
                 tuple(ob.chi for ob in self.obstacles), s["p"], s["vel"],
                 cms, tuple(self._obstacle_ubody(ob) for ob in self.obstacles),
+                tuple(ob.udef for ob in self.obstacles), vunits,
             )
         )
         for i, (ob, row) in enumerate(zip(self.obstacles, F)):
-            f = unpack_forces(row)
-            ob.pres_force = f["pres_force"]
-            ob.visc_force = f["visc_force"]
-            ob.force = ob.pres_force + ob.visc_force
-            ob.torque = f["torque"]
-            ob.pow_out = f["power"]
-            self.logger.write(
-                f"forces_{i}.txt",
-                f"{self.time:.8e} " + " ".join(f"{v:.8e}" for v in ob.force)
-                + f" {ob.pow_out:.8e}\n",
-            )
+            store_force_qoi(ob, unpack_forces(row))
+            log_forces(self.logger, i, self.time, ob)
 
     def simulate(self):
         cfg = self.cfg

@@ -1,0 +1,89 @@
+"""Triangle geometry helpers (reference main.cpp:8341-8463: Vector3,
+Moller-Trumbore rayIntersectsTriangle, pointTriangleSqrDistance).
+
+The reference carries these for externally-meshed obstacles; its condensed
+factory builds only StefanFish, so they are utility parity.  Here they are
+vectorized jnp kernels (batch of rays/points vs batch of triangles) so a
+future mesh-SDF rasterizer can run them as one gather-free device pass.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def ray_intersects_triangle(origin, direction, v0, v1, v2):
+    """Moller-Trumbore: returns (hit mask, t) for rays against triangles.
+
+    All arguments broadcast: origin/direction (..., 3), v0/v1/v2 (..., 3).
+    t is the ray parameter (inf where no hit)."""
+    e1 = v1 - v0
+    e2 = v2 - v0
+    h = jnp.cross(direction, e2)
+    a = jnp.sum(e1 * h, axis=-1)
+    parallel = jnp.abs(a) < _EPS
+    f = 1.0 / jnp.where(parallel, 1.0, a)
+    s = origin - v0
+    u = f * jnp.sum(s * h, axis=-1)
+    q = jnp.cross(s, e1)
+    v = f * jnp.sum(direction * q, axis=-1)
+    t = f * jnp.sum(e2 * q, axis=-1)
+    hit = (
+        (~parallel)
+        & (u >= 0.0)
+        & (u <= 1.0)
+        & (v >= 0.0)
+        & (u + v <= 1.0)
+        & (t > _EPS)
+    )
+    return hit, jnp.where(hit, t, jnp.inf)
+
+
+def point_triangle_sqr_distance(p, v0, v1, v2):
+    """Squared distance from points p (..., 3) to triangles (v0, v1, v2)
+    (..., 3) — the region-based closest-point classification
+    (main.cpp:8395-8463)."""
+    e0 = v1 - v0
+    e1 = v2 - v0
+    d = v0 - p
+    a = jnp.sum(e0 * e0, axis=-1)
+    b = jnp.sum(e0 * e1, axis=-1)
+    c = jnp.sum(e1 * e1, axis=-1)
+    dd = jnp.sum(e0 * d, axis=-1)
+    e = jnp.sum(e1 * d, axis=-1)
+    det = jnp.maximum(a * c - b * b, _EPS)
+    s = b * e - c * dd
+    t = b * dd - a * e
+
+    # barycentric clamping: project onto edges/vertices outside the face
+    inside = (s + t <= det) & (s >= 0) & (t >= 0)
+    s_in = s / det
+    t_in = t / det
+
+    # edge v0-v1 (t = 0)
+    s01 = jnp.clip(jnp.where(a > _EPS, -dd / jnp.maximum(a, _EPS), 0.0), 0, 1)
+    # edge v0-v2 (s = 0)
+    t02 = jnp.clip(jnp.where(c > _EPS, -e / jnp.maximum(c, _EPS), 0.0), 0, 1)
+    # edge v1-v2 (s + t = 1): parameterize q = v1 + w (v2 - v1)
+    e12 = v2 - v1
+    w12 = jnp.clip(
+        jnp.sum((p - v1) * e12, axis=-1)
+        / jnp.maximum(jnp.sum(e12 * e12, axis=-1), _EPS),
+        0,
+        1,
+    )
+
+    def dist2(ss, tt):
+        q = v0 + ss[..., None] * e0 + tt[..., None] * e1
+        r = p - q
+        return jnp.sum(r * r, axis=-1)
+
+    d_face = dist2(s_in, t_in)
+    d01 = dist2(s01, jnp.zeros_like(s01))
+    d02 = dist2(jnp.zeros_like(t02), t02)
+    q12 = v1 + w12[..., None] * e12
+    d12 = jnp.sum((p - q12) ** 2, axis=-1)
+    d_border = jnp.minimum(jnp.minimum(d01, d02), d12)
+    return jnp.where(inside, d_face, d_border)

@@ -1,0 +1,67 @@
+"""Triangle geometry helpers (reference main.cpp:8341-8463)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.utils.geometry import (
+    point_triangle_sqr_distance,
+    ray_intersects_triangle,
+)
+
+V0 = jnp.array([0.0, 0.0, 0.0])
+V1 = jnp.array([1.0, 0.0, 0.0])
+V2 = jnp.array([0.0, 1.0, 0.0])
+
+
+def test_ray_hits_and_misses():
+    o = jnp.array([[0.2, 0.2, 1.0], [2.0, 2.0, 1.0], [0.2, 0.2, 1.0]])
+    d = jnp.array([[0.0, 0.0, -1.0], [0.0, 0.0, -1.0], [0.0, 0.0, 1.0]])
+    hit, t = ray_intersects_triangle(o, d, V0, V1, V2)
+    np.testing.assert_array_equal(np.asarray(hit), [True, False, False])
+    assert abs(float(t[0]) - 1.0) < 1e-6
+
+
+def test_ray_parallel_no_hit():
+    hit, t = ray_intersects_triangle(
+        jnp.array([0.2, 0.2, 1.0]), jnp.array([1.0, 0.0, 0.0]), V0, V1, V2
+    )
+    assert not bool(hit) and np.isinf(float(t))
+
+
+def test_point_triangle_distance_regions():
+    pts = jnp.array(
+        [
+            [0.2, 0.2, 0.5],   # above the face: d = 0.5
+            [-1.0, 0.0, 0.0],  # beyond vertex v0 along -x: d = 1
+            [0.5, -2.0, 0.0],  # below edge v0-v1: d = 2
+            [1.0, 1.0, 0.0],   # outside hypotenuse: closest (0.5, 0.5, 0)
+            [0.1, 0.1, 0.0],   # on the face
+        ]
+    )
+    d2 = np.asarray(point_triangle_sqr_distance(pts, V0, V1, V2))
+    np.testing.assert_allclose(
+        d2, [0.25, 1.0, 4.0, 0.5, 0.0], atol=1e-6
+    )
+
+
+def test_matches_bruteforce_random():
+    rng = np.random.default_rng(0)
+    tri = rng.standard_normal((3, 3)).astype(np.float32)
+    pts = rng.standard_normal((200, 3)).astype(np.float32)
+    d2 = np.asarray(
+        point_triangle_sqr_distance(
+            jnp.asarray(pts), *(jnp.asarray(v) for v in tri)
+        )
+    )
+    # brute force: dense barycentric sampling of the triangle
+    uu, vv = np.meshgrid(np.linspace(0, 1, 400), np.linspace(0, 1, 400))
+    m = uu + vv <= 1.0
+    samples = (
+        tri[0]
+        + uu[m][:, None] * (tri[1] - tri[0])
+        + vv[m][:, None] * (tri[2] - tri[0])
+    )
+    brute = np.min(
+        np.sum((pts[:, None, :] - samples[None]) ** 2, axis=-1), axis=1
+    )
+    np.testing.assert_allclose(d2, brute, atol=5e-4)

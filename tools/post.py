@@ -1,0 +1,58 @@
+"""Render dumped chi fields to a movie (reference tool/post.py:1-45).
+
+Reads our XDMF2 + raw dumps (identical format to the reference's, see
+io/dump.py), scatter-plots body cells (chi > threshold) in the x-z plane
+per frame, and writes post.mp4 (or post.png for a single frame when no
+movie encoder is available).
+
+Usage: python tools/post.py out_dir/dump_*.chi.xdmf2
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.animation
+import matplotlib.pyplot as plt
+import numpy as np
+
+from cup3d_tpu.io.dump import read_dump
+
+THRESHOLD = 0.1  # mollified-band threshold (reference plots chi > 0)
+
+
+def main(paths):
+    if not paths:
+        print("usage: python tools/post.py dump_*.chi.xdmf2")
+        return
+    paths = sorted(paths)
+    fig = plt.figure()
+    plt.axis("equal")
+    plt.axis((0, 1, 0, 1))
+    (points,) = plt.plot([], [], "o", alpha=0.1)
+
+    def plot(path):
+        centers, chi = read_dump(path)
+        sel = chi > THRESHOLD
+        points.set_data(centers[sel, 0], centers[sel, 2])
+
+    if len(paths) == 1:
+        plot(paths[0])
+        fig.savefig("post.png", dpi=120)
+        print("wrote post.png")
+        return
+    anim = matplotlib.animation.FuncAnimation(fig, plot, paths)
+    try:
+        anim.save("post.mp4")
+        print("wrote post.mp4")
+    except Exception:
+        anim.save("post.gif", writer="pillow")
+        print("wrote post.gif")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

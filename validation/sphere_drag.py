@@ -1,0 +1,104 @@
+"""Sphere drag-coefficient validation (VERDICT r1 item 10).
+
+Flow past a fixed sphere at Re = U D / nu, drag from the chi-band traction
+formulation (models/base.py force_integrals), compared against the
+standard drag curve (Schiller-Naumann, valid Re < 800):
+
+    Cd = 24/Re (1 + 0.15 Re^0.687)
+
+Run on TPU:  python validation/sphere_drag.py [Re] [n]
+Appends one JSON line per run to validation/results/sphere_drag.jsonl.
+
+Setup notes: the reference supports no inflow BC, so external flow uses
+the moving-frame trick its fish swim with: the sphere is FORCED to
+translate at -U (bForcedInSimFrame) and bFixFrameOfRef keeps the grid on
+the body, so uinf = +U carries the freestream, the far field stays at
+rest, and freespace boundaries see no through-flow.  D = 0.16 L_domain
+keeps blockage small; drag (the +x force opposing the -x motion) is
+time-averaged over the last third of the run (t U / D > 4).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def schiller_naumann(re: float) -> float:
+    return 24.0 / re * (1.0 + 0.15 * re**0.687)
+
+
+def run(re: float = 100.0, n: int = 128, tend_over_tstar: float = 6.0):
+    import jax.numpy as jnp
+
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.sim.simulation import Simulation
+
+    U = 0.5
+    D = 0.16
+    nu = U * D / re
+    bpd = n // 8
+    cfg = SimulationConfig(
+        bpdx=bpd, bpdy=bpd, bpdz=bpd, levelMax=1, levelStart=0, extent=1.0,
+        CFL=0.3, nu=nu, tend=0.0, nsteps=10**9, rampup=20,
+        BC_x="freespace", BC_y="freespace", BC_z="freespace",
+        poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+        factory_content=(
+            f"Sphere L={D} xpos=0.6 ypos=0.5 zpos=0.5 xvel={-U} "
+            "bForcedInSimFrame=1 bBlockRotation=1 bFixFrameOfRef=1"
+        ),
+        verbose=False, freqDiagnostics=0,
+    )
+    sim = Simulation(cfg)
+    sim.init()
+
+    tstar = D / U
+    tend = tend_over_tstar * tstar
+    area = np.pi * D * D / 4.0
+    qinf = 0.5 * U * U * area
+
+    cds, times = [], []
+    t0 = time.time()
+    while sim.sim.time < tend:
+        sim.advance(sim.calc_max_timestep())
+        ob = sim.sim.obstacles[0]
+        cd = ob.force[0] / qinf  # +x force opposes the -x motion
+        cds.append(float(cd))
+        times.append(sim.sim.time)
+        if sim.sim.step % 50 == 0:
+            print(
+                f"  step {sim.sim.step} t/t*={sim.sim.time / tstar:.2f} "
+                f"Cd={cd:.3f}",
+                flush=True,
+            )
+    cds = np.asarray(cds)
+    times = np.asarray(times)
+    sel = times > (2.0 / 3.0) * tend
+    cd_avg = float(np.mean(cds[sel]))
+    cd_ref = schiller_naumann(re)
+    out = {
+        "case": "sphere_drag",
+        "Re": re,
+        "n": n,
+        "cells_per_D": D * n,
+        "Cd": round(cd_avg, 4),
+        "Cd_ref_schiller_naumann": round(cd_ref, 4),
+        "rel_err": round(abs(cd_avg - cd_ref) / cd_ref, 4),
+        "steps": int(sim.sim.step),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    os.makedirs("validation/results", exist_ok=True)
+    with open("validation/results/sphere_drag.jsonl", "a") as f:
+        f.write(json.dumps(out) + "\n")
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    re = float(sys.argv[1]) if len(sys.argv) > 1 else 100.0
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    run(re, n)
