@@ -42,7 +42,7 @@ def checkpoint_path(directory: str, step: int) -> str:
 def save_checkpoint(driver, path: Optional[str] = None) -> str:
     kind = _driver_kind(driver)
     if kind == "amr":
-        state = driver.state
+        state = {k: driver._unpad(v) for k, v in driver.state.items()}
         time, step, dt = driver.time, driver.step_idx, driver.dt
         uinf, lam = driver.uinf, driver.lambda_penal
         obstacles = driver.obstacles

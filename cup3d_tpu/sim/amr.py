@@ -36,11 +36,14 @@ from cup3d_tpu.grid.octree import Octree, TreeConfig
 from cup3d_tpu.grid.uniform import BC
 from cup3d_tpu.io.logging import BufferedLogger, Profiler
 from cup3d_tpu.models.base import (
+    log_forces,
     momentum_integrals_core,
     pack_forces,
     pack_moments,
+    store_force_qoi,
     unpack_forces,
     unpack_moments,
+    vel_unit,
 )
 from cup3d_tpu.ops import amr_ops
 from cup3d_tpu.ops.chi import heaviside
@@ -51,8 +54,16 @@ _EPS = 1e-6
 
 
 class AMRSimulation:
-    def __init__(self, cfg: SimulationConfig, tree: Optional[Octree] = None):
+    """Adaptive driver.  With ``mesh`` (a 1-D jax Mesh) every block-axis
+    field lives padded + sharded over the devices and all halo exchange /
+    refluxing / Krylov work runs through the ShardedForest
+    (parallel/forest.py) — the distributed execution mode of the
+    reference's GridMPI.  Without it, single-device gather tables."""
+
+    def __init__(self, cfg: SimulationConfig, tree: Optional[Octree] = None,
+                 mesh=None):
         self.cfg = cfg
+        self.mesh = mesh
         self.dtype = jnp.dtype(cfg.dtype)
         periodic = tuple(b == "periodic" for b in cfg.bc)
         if tree is None:
@@ -78,8 +89,8 @@ class AMRSimulation:
         from cup3d_tpu.io.dump import OutputCadence
 
         self._cadence = OutputCadence(cfg.tdump, cfg.fdump, cfg.saveFreq)
-        self._alloc_fields()
         self._rebuild()
+        self._alloc_fields()
 
     # the obstacle classes address their host as `sim`; provide the same
     # attribute surface as SimulationData where they need it
@@ -95,11 +106,18 @@ class AMRSimulation:
     def _alloc_fields(self):
         g = self.grid
         self.state = {
-            "vel": g.zeros(3, self.dtype),
-            "chi": g.zeros(0, self.dtype),
-            "p": g.zeros(0, self.dtype),
-            "udef": g.zeros(3, self.dtype),
+            "vel": self._pad(g.zeros(3, self.dtype)),
+            "chi": self._pad(g.zeros(0, self.dtype)),
+            "p": self._pad(g.zeros(0, self.dtype)),
+            "udef": self._pad(g.zeros(3, self.dtype)),
         }
+
+    def _pad(self, field):
+        """Block-axis pad + shard when running on a device mesh."""
+        return self.forest.pad(field) if self.forest is not None else field
+
+    def _unpad(self, field):
+        return self.forest.unpad(field) if self.forest is not None else field
 
     def uinf_device(self):
         return jnp.asarray(self.uinf, self.dtype)
@@ -109,46 +127,72 @@ class AMRSimulation:
     def _rebuild(self):
         g = self.grid
         cfg = self.cfg
-        self._tab1 = g.lab_tables(1)
-        self._tab3 = g.lab_tables(3)
-        self._ftab = build_flux_tables(g)
-        self._solver = amr_ops.build_amr_poisson_solver(
-            g, tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel,
-            tab=self._tab1, flux_tab=self._ftab,
-        )
-        self._h_col = jnp.asarray(
-            g.h.reshape(g.nb, 1, 1, 1), self.dtype
-        )
-        self._vol = self._h_col**3
-        self._xc = jnp.asarray(g.cell_centers(self.dtype))
+        if self.mesh is not None:
+            from cup3d_tpu.parallel.forest import ShardedForest
+
+            self.forest = ShardedForest(g, self.mesh)
+            geom = self.forest.geom
+            self._tab1 = self.forest.lab_tables(1)
+            self._tab3 = self.forest.lab_tables(3)
+            self._ftab = self.forest.flux_tables
+            self._solver = self.forest.build_poisson_solver(
+                tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel
+            )
+            # padded geometry arrays; cell volume is 0 on padding blocks so
+            # every volume-weighted reduction ignores them, and the padding
+            # rows of all fields are kept at 0 (labs of padding blocks
+            # assemble to zero, so operators never write garbage there)
+            self._vol = jnp.asarray(self.forest.vol, self.dtype)
+            self._h_col = self._pad(
+                jnp.asarray(g.h.reshape(g.nb, 1, 1, 1), self.dtype)
+            )
+            self._xc = self._pad(jnp.asarray(g.cell_centers(self.dtype)))
+            self._real_mask = jnp.asarray(self.forest.pmask, self.dtype)
+        else:
+            self.forest = None
+            geom = g
+            self._tab1 = g.lab_tables(1)
+            self._tab3 = g.lab_tables(3)
+            self._ftab = build_flux_tables(g)
+            self._solver = amr_ops.build_amr_poisson_solver(
+                g, tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel,
+                tab=self._tab1, flux_tab=self._ftab,
+            )
+            self._h_col = jnp.asarray(
+                g.h.reshape(g.nb, 1, 1, 1), self.dtype
+            )
+            self._vol = self._h_col**3
+            self._xc = jnp.asarray(g.cell_centers(self.dtype))
+            self._real_mask = None
+        self._geom = geom
 
         if cfg.implicitDiffusion:
             from cup3d_tpu.ops import diffusion as dif
 
             helm = dif.build_amr_helmholtz_solver(
-                g, tol_abs=cfg.diffusionTol, tol_rel=cfg.diffusionTolRel,
+                geom, tol_abs=cfg.diffusionTol, tol_rel=cfg.diffusionTolRel,
                 tab=self._tab1, flux_tab=self._ftab,
             )
             self._advdiff = jax.jit(
                 lambda vel, dt, uinf: dif.implicit_step_blocks(
-                    g, vel, dt, self.nu, uinf, self._tab3, helm
+                    geom, vel, dt, self.nu, uinf, self._tab3, helm
                 )
             )
         else:
             self._advdiff = jax.jit(
                 lambda vel, dt, uinf: amr_ops.rk3_step_blocks(
-                    g, vel, dt, self.nu, uinf, self._tab3, self._ftab
+                    geom, vel, dt, self.nu, uinf, self._tab3, self._ftab
                 )
             )
         self._project = jax.jit(
             lambda vel, dt, chi, udef, p_old: amr_ops.project_blocks(
-                g, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
+                geom, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
                 p_init=p_old,
             )
         )
         self._project_2nd = jax.jit(
             lambda vel, dt, chi, udef, p_old: amr_ops.project_blocks(
-                g, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
+                geom, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
                 p_init=p_old, second_order=True,
             )
         )
@@ -159,7 +203,7 @@ class AMRSimulation:
                 [
                     pack_forces(
                         amr_ops.force_integrals_blocks(
-                            g, self._tab1, self._xc, c, p, vel, self.nu,
+                            geom, self._tab1, self._xc, c, p, vel, self.nu,
                             cms[i], ubodies[i], udefs[i], vunits[i]
                         )
                     )
@@ -176,21 +220,21 @@ class AMRSimulation:
             + udef
         )
         self._divnorms = jax.jit(
-            lambda vel: amr_ops.divergence_norms_blocks(g, vel, self._tab1)
+            lambda vel: amr_ops.divergence_norms_blocks(geom, vel, self._tab1)
         )
         self._dissipation = jax.jit(
-            lambda vel: amr_ops.dissipation_blocks(g, vel, self.nu, self._tab1)
+            lambda vel: amr_ops.dissipation_blocks(geom, vel, self.nu, self._tab1)
         )
         self._gradchi = jax.jit(
             lambda chi: amr_ops.grad_blocks(
-                g, self._tab1.assemble_scalar(chi, g.bs), self._tab1.width
+                geom, self._tab1.assemble_scalar(chi, g.bs), self._tab1.width
             )
         )
         self._omega_mag = jax.jit(
             lambda vel: jnp.sqrt(
                 jnp.sum(
                     amr_ops.curl_blocks(
-                        g, self._tab1.assemble_vector(vel, g.bs), self._tab1.width
+                        geom, self._tab1.assemble_vector(vel, g.bs), self._tab1.width
                     )
                     ** 2,
                     axis=-1,
@@ -199,8 +243,8 @@ class AMRSimulation:
         )
 
         def scores(vel, chi):
-            vort = amr_ops.vorticity_score(g, vel, self._tab1)
-            near_body = amr_ops.gradchi_mask(g, chi, self._tab1)
+            vort = amr_ops.vorticity_score(geom, vel, self._tab1)
+            near_body = amr_ops.gradchi_mask(geom, chi, self._tab1)
             return vort, near_body
 
         self._scores = jax.jit(scores)
@@ -236,6 +280,10 @@ class AMRSimulation:
                 (self._xc[..., 1] / g.extent[1]), self.dtype
             )
             profile = 6.0 * eta * (1.0 - eta)
+            if self._real_mask is not None:
+                # (nb_pad,1,1,1) mask broadcasts over the (nb_pad,8,8,8)
+                # profile; padding rows stay 0
+                profile = profile * self._real_mask
 
             def fix_flux(vel, uinf_x, u_target):
                 u_msr = (
@@ -264,15 +312,20 @@ class AMRSimulation:
         if fixed:
             self.uinf = -np.mean([ob.transVel for ob in fixed], axis=0)
         chis, udefs = [], []
+        h_raw = jnp.asarray(
+            self.grid.h.reshape(self.grid.nb, 1, 1, 1), self.dtype
+        )
         for ob in self.obstacles:
             ob.update_shape(self.time, dt)
-            sdf, udef = ob.rasterize(self.time)
-            ob.chi = heaviside(sdf, self._h_col)
-            ob.udef = (
-                udef * (ob.chi > 0)[..., None]
+            sdf, udef = ob.rasterize(self.time)  # unpadded (nb, ...)
+            chi = heaviside(sdf, h_raw)
+            udef = (
+                udef * (chi > 0)[..., None]
                 if udef is not None
                 else self.grid.zeros(3, self.dtype)
             )
+            ob.chi = self._pad(chi)
+            ob.udef = self._pad(udef)
             chis.append(ob.chi)
             udefs.append(ob.udef)
         stack = jnp.stack(chis)
@@ -311,8 +364,8 @@ class AMRSimulation:
         g = self.grid
         cfg = self.cfg
         vort, near_body = self._scores(self.state["vel"], self.state["chi"])
-        score = np.asarray(vort, np.float64)
-        near = np.asarray(near_body)
+        score = np.asarray(vort, np.float64)[: g.nb]
+        near = np.asarray(near_body)[: g.nb]
         if cfg.bAdaptChiGradient and near.any():
             score = np.where(near, np.inf, score)
         # per-block refinement cap: levelMaxVorticity away from bodies
@@ -321,12 +374,14 @@ class AMRSimulation:
         plan = ad.adapt(g, states)
         if plan is None:
             return False
-        for k in ("vel", "udef"):
-            self.state[k] = ad.transfer_field(g, plan, self.state[k])
-        for k in ("chi", "p"):
-            self.state[k] = ad.transfer_field(g, plan, self.state[k])
+        for k in ("vel", "udef", "chi", "p"):
+            self.state[k] = ad.transfer_field(
+                g, plan, self._unpad(self.state[k])
+            )
         self.grid = plan.new_grid
         self._rebuild()
+        for k in self.state:
+            self.state[k] = self._pad(self.state[k])
         return True
 
     # -- initialization ----------------------------------------------------
@@ -403,7 +458,13 @@ class AMRSimulation:
 
         from cup3d_tpu.io import dump as dmp
 
-        fields = dmp.collect_dump_fields(self.cfg, self.state, self._omega_mag)
+        state_view = {k: self._unpad(v) for k, v in self.state.items()}
+        fields = dmp.collect_dump_fields(
+            self.cfg, state_view,
+            lambda _vel: np.asarray(
+                self._unpad(self._omega_mag(self.state["vel"]))
+            ),
+        )
         if fields:
             prefix = os.path.join(
                 self.cfg.path4serialization, f"dump_{self.step_idx:07d}"
@@ -461,10 +522,15 @@ class AMRSimulation:
                 self._fix_mass_flux()
         elif self.cfg.uMax_forced > 0:
             # constant streamwise acceleration (ExternalForcing,
-            # main.cpp:10581-10596)
+            # main.cpp:10581-10596); padding rows stay 0
             H = self.grid.extent[1]
             accel = 8.0 * self.nu * self.cfg.uMax_forced / (H * H)
-            s["vel"] = s["vel"].at[..., 0].add(accel * dt)
+            add = accel * dt
+            if self._real_mask is not None:
+                add = add * self._real_mask
+            s["vel"] = s["vel"].at[..., 0].add(
+                add if np.ndim(add) else float(add)
+            )
         with self.profiler("PressureProjection"):
             # warm-start the Krylov solve from the previous pressure; after
             # step_2nd_start use the reference's increment form
@@ -515,12 +581,6 @@ class AMRSimulation:
         """Per-obstacle force/torque/power QoI (reference ComputeForces,
         main.cpp:12496-12503, reduction 13079-13115)."""
         s = self.state
-        from cup3d_tpu.models.base import (
-            log_forces,
-            store_force_qoi,
-            vel_unit,
-        )
-
         cms = jnp.asarray(
             np.stack([ob.centerOfMass for ob in self.obstacles]), self.dtype
         )

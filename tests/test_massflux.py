@@ -45,3 +45,29 @@ def test_fix_mass_flux_amr_accepted_and_converges():
     u = np.asarray(sim.state["vel"])[..., 0]
     u_avg = float(np.sum(u * vol) / np.sum(vol * np.ones_like(u)))
     assert abs(u_avg - target) < 0.05 * target, (u_avg, target)
+
+
+def test_fix_mass_flux_amr_on_device_mesh():
+    """bFixMassFlux + sharded forest: the padding-mask broadcast must hold
+    on a padded block axis (regression: (nb_pad,1,1) vs (nb_pad,8,8,8))."""
+    import jax
+
+    from cup3d_tpu.parallel.forest import make_block_mesh
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    cfg = SimulationConfig(
+        bpdx=2, bpdy=1, bpdz=1, levelMax=2, levelStart=1, extent=1.0,
+        BC_y="wall", nu=1e-2, uMax_forced=0.3, bFixMassFlux=True,
+        dt=1e-3, nsteps=4, tend=0.0, verbose=False,
+        poissonSolver="iterative", poissonTol=1e-4, poissonTolRel=1e-2,
+        Rtol=1e9, Ctol=-1.0,
+    )
+    sim = AMRSimulation(cfg, mesh=make_block_mesh(jax.devices()[:8]))
+    sim.init()
+    target = 2.0 / 3.0 * cfg.uMax_forced
+    while sim.step_idx < cfg.nsteps:
+        sim.advance(sim.calc_max_timestep())
+    vol = np.asarray(sim._vol)
+    u = np.asarray(sim.state["vel"])[..., 0]
+    u_avg = float(np.sum(u * vol) / np.sum(vol * np.ones_like(u)))
+    assert abs(u_avg - target) < 0.1 * target, (u_avg, target)
