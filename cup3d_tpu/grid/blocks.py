@@ -226,6 +226,18 @@ class BlockGrid:
             raise KeyError("unresolved owner: tree not 2:1 balanced?")
         return own
 
+    @staticmethod
+    def _interp_matrix(L: int, S: int, w: int, cw: int) -> np.ndarray:
+        """Separable quadratic upsample matrix (L, S), identical per block."""
+        W = np.zeros((L, S), np.float32)
+        for f in range(L):
+            g = f - w
+            p = g // 2 + cw
+            par = g & 1
+            for d, wq in zip((-1, 0, 1), _WQ[par]):
+                W[f, p + d] += wq
+        return W
+
     def _flat_idx(self, l: int, cell: np.ndarray) -> np.ndarray:
         """Flat field index of level-l cell coords (..., 3) owned by level-l
         leaves.  Out-of-tree positions -> sentinel."""
@@ -257,6 +269,28 @@ class BlockGrid:
         interior = np.all((gg >= w) & (gg < w + bs), axis=-1)
         gxyz = gg[~interior]  # (ng, 3)
         ng = gxyz.shape[0]
+
+        # native fast path: the C++ builder (native/tables.cpp) produces
+        # bit-identical tables; the numpy path below stays as the
+        # always-available reference implementation
+        from cup3d_tpu import native
+
+        nat = native.build_lab_tables(self, w, gxyz, cw)
+        if nat is not None:
+            W = self._interp_matrix(L, S, w, cw)
+            return LabTables(
+                width=w,
+                ghost_xyz=(gxyz[:, 0], gxyz[:, 1], gxyz[:, 2]),
+                g_idx=jnp.asarray(nat["g_idx"], jnp.int32),
+                g_w=jnp.asarray(nat["g_w"]),
+                g_sign=jnp.asarray(nat["g_sign"]),
+                mask_coarse=jnp.asarray(nat["mask_coarse"]),
+                s_idx=jnp.asarray(nat["s_idx"], jnp.int32),
+                s_w=jnp.asarray(nat["s_w"]),
+                s_sign=jnp.asarray(nat["s_sign"]),
+                interp_w=jnp.asarray(W),
+                any_coarse=nat["any_coarse"],
+            )
 
         g_idx = np.full((nb, ng, 8), sentinel, np.int64)
         g_w = np.zeros((nb, ng, 8), np.float32)
@@ -340,14 +374,7 @@ class BlockGrid:
             s_idx[bsel] = si
             s_w[bsel] = sw
 
-        # separable quadratic upsample matrix W: (L, S), identical per block
-        W = np.zeros((L, S), np.float32)
-        for f in range(L):
-            g = f - w
-            p = g // 2 + cw
-            par = g & 1
-            for d, wq in zip((-1, 0, 1), _WQ[par]):
-                W[f, p + d] += wq
+        W = self._interp_matrix(L, S, w, cw)
 
         return LabTables(
             width=w,

@@ -1,0 +1,135 @@
+"""ctypes bridge to the native table builder (native/tables.cpp).
+
+The reference's synchronizer setup is C++ (SynchronizerMPI_AMR::_Setup,
+main.cpp:1979-2322); ours is too: the per-adaptation gather-table build
+runs in native/libcup3d_tables.so when available (built lazily with the
+in-tree Makefile on first use), with the vectorized numpy implementation
+in grid/blocks.py as the always-available reference — the same
+optimized-kernel-vs-reference-kernel pattern the upstream uses for its
+SIMD hot loops (main.cpp:9186-9190).
+
+Disable with CUP3D_NO_NATIVE=1.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libcup3d_tables.so")
+
+_lib = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("CUP3D_NO_NATIVE"):
+        return None
+    # always invoke make: its mtime check is a ~ms no-op when the .so is
+    # current, and rebuilds when tables.cpp changed (a stale gitignored
+    # .so would otherwise be loaded silently)
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        if not os.path.exists(_LIB_PATH):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    lib.cup3d_build_lab_tables.restype = ctypes.c_int
+    lib.cup3d_build_lab_tables.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # nb bs w lmax
+        i64p, i32p, i32p, i64p,  # bpd bc levels ijk
+        i32p, u8p, i64p,  # slot_flat int_flat lvl_off
+        ctypes.c_int, i64p,  # ng gxyz
+        i64p, f32p, f32p, u8p,  # g_idx g_w g_sign mask
+        ctypes.c_int, i64p, f32p, f32p,  # cw s_idx s_w s_sign
+        ctypes.POINTER(ctypes.c_int32),  # any_coarse
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_lab_tables(grid, w: int, gxyz: np.ndarray, cw: int):
+    """Native lab-table build for BlockGrid ``grid`` at stencil width w.
+
+    gxyz: (ng, 3) lab-coordinate ghost list (the same list the numpy
+    builder enumerates).  Returns the table arrays or None if the native
+    library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    nb, bs = grid.nb, grid.bs
+    cfg = grid.tree.cfg
+    ng = gxyz.shape[0]
+    cbs = bs // 2
+    S = cbs + 2 * cw
+    ns = S**3
+
+    # flatten the per-level dense maps
+    lvl_off = np.zeros(cfg.level_max + 1, np.int64)
+    for l in range(cfg.level_max):
+        lvl_off[l + 1] = lvl_off[l] + grid._slot_maps[l].size
+    slot_flat = np.concatenate(
+        [np.ascontiguousarray(m.reshape(-1)) for m in grid._slot_maps]
+    ).astype(np.int32)
+    int_flat = np.concatenate(
+        [np.ascontiguousarray(m.reshape(-1)) for m in grid._int_maps]
+    ).astype(np.uint8)
+
+    _bc_code = {"periodic": 0, "wall": 1, "freespace": 2}
+    bc_codes = np.array([_bc_code[b.value] for b in grid.bc], np.int32)
+
+    g_idx = np.empty((nb, ng, 8), np.int64)
+    g_w = np.empty((nb, ng, 8), np.float32)
+    g_sign = np.empty((nb, ng, 3), np.float32)
+    mask = np.empty((nb, ng), np.uint8)
+    s_idx = np.empty((nb, ns, 8), np.int64)
+    s_w = np.empty((nb, ns, 8), np.float32)
+    s_sign = np.empty((nb, ns, 3), np.float32)
+    any_coarse = ctypes.c_int32(0)
+
+    rc = lib.cup3d_build_lab_tables(
+        nb, bs, w, cfg.level_max,
+        np.ascontiguousarray(np.asarray(cfg.bpd, np.int64)),
+        bc_codes,
+        np.ascontiguousarray(grid.level.astype(np.int32)),
+        np.ascontiguousarray(grid.ijk.astype(np.int64).reshape(-1)),
+        slot_flat, int_flat, lvl_off,
+        ng, np.ascontiguousarray(gxyz.astype(np.int64).reshape(-1)),
+        g_idx.reshape(-1), g_w.reshape(-1), g_sign.reshape(-1),
+        mask.reshape(-1),
+        cw, s_idx.reshape(-1), s_w.reshape(-1), s_sign.reshape(-1),
+        ctypes.byref(any_coarse),
+    )
+    if rc != 0:
+        raise KeyError("unresolved owner: tree not 2:1 balanced?")
+    return {
+        "g_idx": g_idx, "g_w": g_w, "g_sign": g_sign,
+        "mask_coarse": mask.astype(bool),
+        "s_idx": s_idx, "s_w": s_w, "s_sign": s_sign,
+        "any_coarse": bool(any_coarse.value),
+    }

@@ -390,10 +390,11 @@ class AMRSimulation:
         if self.cfg.initCond == "taylorGreen":
             from cup3d_tpu.utils.flows import taylor_green_2d
 
-            self.state["vel"] = taylor_green_2d(self.grid, dtype=self.dtype)
+            vel = taylor_green_2d(self.grid, dtype=self.dtype)
         else:
-            self.state["vel"] = self.grid.zeros(3, self.dtype)
-        self.state["p"] = self.grid.zeros(0, self.dtype)
+            vel = self.grid.zeros(3, self.dtype)
+        self.state["vel"] = self._pad(vel)
+        self.state["p"] = self._pad(self.grid.zeros(0, self.dtype))
 
     def init(self):
         """Reference init(): obstacles, IC, then 3*levelMax adaptation

@@ -255,3 +255,29 @@ def test_amr_driver_on_device_mesh_matches_single():
     )
     # mesh really is in play: fields are padded + sharded
     assert sh.state["vel"].shape[0] == sh.forest.nb_pad
+
+
+def test_amr_driver_mesh_nb_not_divisible():
+    """nb=15 blocks on 8 devices (nb_pad=16): padding must be applied on
+    every state-assignment path, including _ic (regression: unpadded IC
+    crashed shard_map with a divisibility error)."""
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.parallel.forest import make_block_mesh
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    tree = Octree(TreeConfig((2, 2, 2), 2, (True,) * 3), 0)
+    tree.refine((0, 0, 0, 0))  # 7 coarse + 8 fine = 15 leaves
+    cfg = SimulationConfig(
+        bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=0, extent=1.0,
+        nu=1e-3, nsteps=2, tend=0.0, verbose=False,
+        poissonSolver="iterative", poissonTol=1e-3, poissonTolRel=1e-2,
+        initCond="taylorGreen", Rtol=1e9, Ctol=-1.0,
+    )
+    sim = AMRSimulation(cfg, tree=tree,
+                        mesh=make_block_mesh(jax.devices()[:8]))
+    sim.init()
+    assert sim.grid.nb % 8 != 0  # the interesting case
+    assert sim.state["vel"].shape[0] == sim.forest.nb_pad
+    for _ in range(2):
+        sim.advance(sim.calc_max_timestep())
+    assert np.all(np.isfinite(np.asarray(sim.state["vel"])))
