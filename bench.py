@@ -24,7 +24,9 @@ CubismUP-class codes sustain ~2e6 cell-updates/s/core on full NS steps at
 matched Poisson tolerance, see BASELINE.md).
 
 Env knobs: CUP3D_BENCH_CONFIG=fish|tgv|spectral|amr|all (default all),
-CUP3D_BENCH_N (downscale resolutions for CPU smoke testing).
+CUP3D_BENCH_N (downscale resolutions for CPU smoke testing),
+CUP3D_BENCH_PROFILE=<dir> (capture a jax.profiler trace of the timed
+region of each config for TensorBoard / xprof).
 """
 
 import json
@@ -43,13 +45,38 @@ def _scaled(n_default: int) -> int:
     return max(16, (n // 8) * 8)  # grids are built from 8^3 blocks
 
 
-def _time_steps(advance, calc_dt, warmup: int, iters: int) -> float:
+class _maybe_trace:
+    """jax.profiler trace of the timed region when CUP3D_BENCH_PROFILE is
+    set (SURVEY.md section 5: per-operator tracing the reference lacks)."""
+
+    def __init__(self, tag: str):
+        self.dir = os.environ.get("CUP3D_BENCH_PROFILE")
+        self.tag = tag
+
+    def __enter__(self):
+        if self.dir:
+            import jax
+
+            jax.profiler.start_trace(os.path.join(self.dir, self.tag))
+        return self
+
+    def __exit__(self, *exc):
+        if self.dir:
+            import jax
+
+            jax.profiler.stop_trace()
+        return False
+
+
+def _time_steps(advance, calc_dt, warmup: int, iters: int,
+                tag: str = "run") -> float:
     for _ in range(warmup):
         advance(calc_dt())
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        advance(calc_dt())
-    return (time.perf_counter() - t0) / iters
+    with _maybe_trace(tag):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            advance(calc_dt())
+        return (time.perf_counter() - t0) / iters
 
 
 def bench_fish_uniform():
@@ -78,7 +105,7 @@ def bench_fish_uniform():
     sim.init()
     iters = 8
     wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=3,
-                       iters=iters)
+                       iters=iters, tag="fish")
     cells_s = n**3 / wall
 
     from cup3d_tpu.ops import diagnostics as diag
@@ -159,14 +186,15 @@ def bench_tgv_iterative():
         vel, p = step(vel, dt, uinf)
     float(vel[0, 0, 0, 0])
     iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        vel, p = step(vel, dt, uinf)
-        # a scalar host read forces execution: block_until_ready alone is
-        # unreliable on the experimental TPU platform (chained dispatches
-        # report ready without running)
-        float(vel[0, 0, 0, 0])
-    wall = (time.perf_counter() - t0) / iters
+    with _maybe_trace("tgv_iterative"):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            vel, p = step(vel, dt, uinf)
+            # a scalar host read forces execution: block_until_ready alone
+            # is unreliable on the experimental TPU platform (chained
+            # dispatches report ready without running)
+            float(vel[0, 0, 0, 0])
+        wall = (time.perf_counter() - t0) / iters
 
     from cup3d_tpu.ops import diagnostics as diag
 
@@ -199,11 +227,12 @@ def bench_spectral():
         vel, p = step(vel, dt, uinf)
     float(vel[0, 0, 0, 0])
     iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        vel, p = step(vel, dt, uinf)
-        float(vel[0, 0, 0, 0])  # forced sync (see bench_tgv_iterative)
-    wall = (time.perf_counter() - t0) / iters
+    with _maybe_trace("spectral"):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            vel, p = step(vel, dt, uinf)
+            float(vel[0, 0, 0, 0])  # forced sync (see bench_tgv_iterative)
+        wall = (time.perf_counter() - t0) / iters
     return {"cells_per_s": n**3 / wall, "wall_per_step_s": round(wall, 5),
             "n": n}
 
@@ -233,7 +262,7 @@ def bench_two_fish_amr():
     sim.init()
     iters = 6
     wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=2,
-                       iters=iters)
+                       iters=iters, tag="two_fish_amr")
     total, div_max = sim._divnorms(sim.state["vel"])
     nb = sim.grid.nb
     return {

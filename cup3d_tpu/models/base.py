@@ -392,3 +392,20 @@ def log_forces(logger, i: int, time: float, ob) -> None:
         + f" {ob.pow_out:.8e} {ob.thrust:.8e} {ob.drag:.8e}"
         + f" {ob.def_power:.8e} {ob.EffPDef:.8e}\n",
     )
+
+
+def update_penalization_forces(obstacles, penal_force_fn, vel_new, vel_old,
+                               dt, dtype) -> None:
+    """Attach per-obstacle momentum-balance force/torque (reference
+    kernelFinalizePenalizationForce, main.cpp:13913-13938).  The (n_obs, 6)
+    result stays a device array — rows are attached as lazy slices so the
+    hot loop never blocks on a host transfer; consumers that read
+    ob.penal_force trigger the (tiny) conversion themselves."""
+    cms = jnp.asarray(np.stack([ob.centerOfMass for ob in obstacles]), dtype)
+    PF = penal_force_fn(
+        vel_new, vel_old, tuple(ob.chi for ob in obstacles),
+        jnp.asarray(dt, dtype), cms,
+    )
+    for i, ob in enumerate(obstacles):
+        ob.penal_force = PF[i, :3]
+        ob.penal_torque = PF[i, 3:]

@@ -23,7 +23,10 @@ from cup3d_tpu.models.base import (
     unpack_moments,
     vel_unit,
 )
-from cup3d_tpu.ops.penalization import penalize
+from cup3d_tpu.ops.penalization import (
+    penalize,
+    per_obstacle_penalization_force,
+)
 from cup3d_tpu.sim.data import SimulationData
 from cup3d_tpu.sim.operators import Operator
 
@@ -98,6 +101,12 @@ class Penalization(Operator):
 
         self._gradchi = jax.jit(partial(grad_chi, sim.grid))
         self._xc = sim.xc  # device-cached centers (sim/data.py)
+        h3 = sim.grid.h ** 3
+        self._penal_force = jax.jit(
+            lambda vn, vo, chis, dt, cms: per_obstacle_penalization_force(
+                vn, vo, chis, dt, h3, sim.xc, cms
+            )
+        )
 
     def __call__(self, dt):
         s = self.sim
@@ -116,9 +125,16 @@ class Penalization(Operator):
         num = sum(ob.chi[..., None] * ub for ob, ub in zip(s.obstacles, ubs))
         den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
         ubody = num / den
+        vel_old = s.state["vel"]
         s.state["vel"] = self._penalize(
-            s.state["vel"], s.state["chi"], ubody,
+            vel_old, s.state["chi"], ubody,
             jnp.asarray(s.lambda_penal, s.dtype), jnp.asarray(dt, s.dtype),
+        )
+        from cup3d_tpu.models.base import update_penalization_forces
+
+        update_penalization_forces(
+            s.obstacles, self._penal_force, s.state["vel"], vel_old, dt,
+            s.dtype,
         )
 
 

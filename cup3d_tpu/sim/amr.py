@@ -47,7 +47,10 @@ from cup3d_tpu.models.base import (
 )
 from cup3d_tpu.ops import amr_ops
 from cup3d_tpu.ops.chi import heaviside
-from cup3d_tpu.ops.penalization import penalize
+from cup3d_tpu.ops.penalization import (
+    penalize,
+    per_obstacle_penalization_force,
+)
 
 ADAPT_EVERY = 20  # reference cadence (main.cpp:15314)
 _EPS = 1e-6
@@ -197,6 +200,11 @@ class AMRSimulation:
             )
         )
         self._penalize = jax.jit(penalize)
+        self._penal_force = jax.jit(
+            lambda vn, vo, chis, dt, cms: per_obstacle_penalization_force(
+                vn, vo, chis, dt, self._vol, self._xc, cms
+            )
+        )
         # ALL obstacles' force QoI in one (n_obs, 13) host read per step
         self._forces = jax.jit(
             lambda chis, p, vel, cms, ubodies, udefs, vunits: jnp.stack(
@@ -514,9 +522,16 @@ class AMRSimulation:
                         self._xc,
                         dt,
                     )
+                vel_old = s["vel"]
                 s["vel"] = self._penalize(
-                    s["vel"], s["chi"], self._body_velocity(),
+                    vel_old, s["chi"], self._body_velocity(),
                     jnp.asarray(self.lambda_penal, self.dtype), dt_j,
+                )
+                from cup3d_tpu.models.base import update_penalization_forces
+
+                update_penalization_forces(
+                    self.obstacles, self._penal_force, s["vel"], vel_old,
+                    dt, self.dtype,
                 )
         if self.cfg.bFixMassFlux:
             with self.profiler("FixMassFlux"):
