@@ -118,7 +118,7 @@ def bench_fish_uniform():
     s = sim.sim
     grid = s.grid
     A = krylov.make_laplacian(grid)
-    M = krylov.make_block_cg_preconditioner(8, 12, h=grid.h)
+    M = krylov.make_block_cg_preconditioner(8, 24, h=grid.h)
     rhs = pressure_rhs(grid, s.state["vel"], s.dt, s.state["chi"],
                        s.state["udef"])
     rhs = rhs - jnp.mean(rhs)

@@ -396,13 +396,16 @@ def log_forces(logger, i: int, time: float, ob) -> None:
 
 def update_penalization_forces(obstacles, penal_force_fn, vel_new, vel_old,
                                dt, dtype) -> None:
-    """Attach per-obstacle momentum-balance force/torque (reference
-    kernelFinalizePenalizationForce, main.cpp:13913-13938).  The (n_obs, 6)
-    result stays a device array — rows are attached as lazy slices so the
-    hot loop never blocks on a host transfer; consumers that read
-    ob.penal_force trigger the (tiny) conversion themselves."""
+    """Attach per-obstacle momentum-balance force/torque ON THE BODY
+    (reference kernelFinalizePenalizationForce, main.cpp:13913-13938) —
+    the negative of the momentum the penalization injects into the fluid,
+    so the sign convention matches ob.force from the surface integral.
+    Computed every step like the reference.  The (n_obs, 6) result stays
+    a device array — rows are attached as lazy slices so the hot loop
+    never blocks on a host transfer; consumers that read ob.penal_force
+    trigger the (tiny) conversion themselves."""
     cms = jnp.asarray(np.stack([ob.centerOfMass for ob in obstacles]), dtype)
-    PF = penal_force_fn(
+    PF = -penal_force_fn(
         vel_new, vel_old, tuple(ob.chi for ob in obstacles),
         jnp.asarray(dt, dtype), cms,
     )

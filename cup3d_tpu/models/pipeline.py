@@ -21,6 +21,7 @@ from cup3d_tpu.models.base import (
     store_force_qoi,
     unpack_forces,
     unpack_moments,
+    update_penalization_forces,
     vel_unit,
 )
 from cup3d_tpu.ops.penalization import (
@@ -130,8 +131,6 @@ class Penalization(Operator):
             vel_old, s.state["chi"], ubody,
             jnp.asarray(s.lambda_penal, s.dtype), jnp.asarray(dt, s.dtype),
         )
-        from cup3d_tpu.models.base import update_penalization_forces
-
         update_penalization_forces(
             s.obstacles, self._penal_force, s.state["vel"], vel_old, dt,
             s.dtype,

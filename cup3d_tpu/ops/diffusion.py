@@ -199,7 +199,7 @@ def build_amr_helmholtz_solver(
     tol_abs: float = 1e-6,
     tol_rel: float = 1e-4,
     maxiter: int = 1000,
-    precond_iters: int = 12,
+    precond_iters: int = 24,
     tab: Optional[LabTables] = None,
     flux_tab: Optional[FluxTables] = None,
 ) -> Callable:

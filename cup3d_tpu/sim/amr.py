@@ -43,6 +43,7 @@ from cup3d_tpu.models.base import (
     store_force_qoi,
     unpack_forces,
     unpack_moments,
+    update_penalization_forces,
     vel_unit,
 )
 from cup3d_tpu.ops import amr_ops
@@ -527,8 +528,6 @@ class AMRSimulation:
                     vel_old, s["chi"], self._body_velocity(),
                     jnp.asarray(self.lambda_penal, self.dtype), dt_j,
                 )
-                from cup3d_tpu.models.base import update_penalization_forces
-
                 update_penalization_forces(
                     self.obstacles, self._penal_force, s["vel"], vel_old,
                     dt, self.dtype,

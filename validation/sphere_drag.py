@@ -67,9 +67,8 @@ def run(re: float = 100.0, n: int = 128, tend_over_tstar: float = 6.0):
         sim.advance(sim.calc_max_timestep())
         ob = sim.sim.obstacles[0]
         cd = ob.force[0] / qinf  # +x force opposes the -x motion
-        # momentum-balance drag: force ON the body = -(penalization force
-        # injected into the fluid)
-        cd_p = -ob.penal_force[0] / qinf
+        # momentum-balance drag (body-frame sign, like ob.force)
+        cd_p = float(ob.penal_force[0]) / qinf
         cds.append(float(cd))
         cds_p.append(float(cd_p))
         times.append(sim.sim.time)
