@@ -11,6 +11,15 @@ momentum integrals, and force reductions are fused whole-domain kernels.
 (computeVelocities, main.cpp:12921-13029; update, main.cpp:13116-13204).
 Here the 6x6 solve is numpy (host, tiny) and the quaternion update uses the
 exact exponential map.
+
+Device fast path: on the tunneled TPU every blocking host read costs ~75 ms,
+so ``rigid_update_device`` runs the same moments -> 6x6 -> position/quaternion
+update entirely on device (the 6x6 is block-diagonal about the CM: u = P/m,
+omega = J^-1 L).  The driver then fetches one packed QoI vector per step
+(``RIGID_PACK`` below) instead of three separate round trips; host mirrors are
+refreshed from that single read before any host code consumes them, so the
+numerics match the host path to solver-dtype round-trip (asserted by
+tests/test_sphere.py::test_device_fast_path_matches_host).
 """
 
 from __future__ import annotations
@@ -61,6 +70,70 @@ def quat_integrate(q: np.ndarray, omega: np.ndarray, dt: float) -> np.ndarray:
     dq = np.concatenate([[np.cos(th / 2)], np.sin(th / 2) * axis])
     q = quat_multiply(dq, q)
     return q / np.linalg.norm(q)
+
+
+# -- device twins of the rigid-body update (single-sync fast path) -----------
+
+RIGID_STATE = 19  # trans(3) ang(3) pos(3) absPos(3) cm(3) quat(4)
+RIGID_PACK = 29   # RIGID_STATE + mass(1) + J(9)
+
+
+def quat_multiply_dev(a, b):
+    aw, ax, ay, az = a[0], a[1], a[2], a[3]
+    bw, bx, by, bz = b[0], b[1], b[2], b[3]
+    return jnp.stack(
+        [
+            aw * bw - ax * bx - ay * by - az * bz,
+            aw * bx + ax * bw + ay * bz - az * by,
+            aw * by - ax * bz + ay * bw + az * bx,
+            aw * bz + ax * by - ay * bx + az * bw,
+        ]
+    )
+
+
+def quat_integrate_dev(q, omega, dt):
+    """Device twin of quat_integrate (exact exponential map)."""
+    n = jnp.linalg.norm(omega)
+    th = n * dt
+    axis = omega / jnp.where(n > 0, n, 1.0)
+    dq = jnp.concatenate([jnp.cos(th / 2)[None], jnp.sin(th / 2) * axis])
+    qn = quat_multiply_dev(dq, q)
+    qn = qn / jnp.linalg.norm(qn)
+    return jnp.where(th < 1e-14, q, qn)
+
+
+def rigid_update_device(mom, state, forced_mask, block_mask, uinf, dt):
+    """Moments (19,) + rigid state (RIGID_STATE,) -> updated (RIGID_PACK,).
+
+    Device twin of compute_velocities + update: the 6x6 momentum system is
+    block-diagonal about the measured CM (reference computeVelocities,
+    main.cpp:12921-13029), so u = P/m and omega = J^-1 L; forced/blocked
+    components keep their previous values; position/quaternion advance as in
+    update (main.cpp:13116-13204)."""
+    m = mom[0]
+    center, P, L = mom[1:4], mom[4:7], mom[7:10]
+    J = mom[10:19].reshape(3, 3)
+    has = m > 0
+    minv = 1.0 / jnp.where(has, m, 1.0)
+    ut0, om0 = state[0:3], state[3:6]
+    cm_meas = jnp.where(has, center * minv, state[12:15])
+    Jsafe = jnp.where(has, J, jnp.eye(3, dtype=mom.dtype))
+    ut = jnp.where(has, P * minv, ut0)
+    om = jnp.where(has, jnp.linalg.solve(Jsafe, L), om0)
+    ut = jnp.where(forced_mask, ut0, ut)
+    om = jnp.where(block_mask, om0, om)
+    pos = state[6:9] + dt * (ut + uinf)
+    absp = state[9:12] + dt * ut
+    cm = cm_meas + dt * (ut + uinf)
+    q = quat_integrate_dev(state[15:19], om, dt)
+    return jnp.concatenate(
+        [ut, om, pos, absp, cm, q, m[None], J.reshape(9)]
+    )
+
+
+def vel_unit_dev(v):
+    n = jnp.linalg.norm(v)
+    return jnp.where(n > 1e-21, v / jnp.where(n > 0, n, 1.0), 0.0)
 
 
 class Obstacle:
@@ -115,6 +188,10 @@ class Obstacle:
         self.collision_counter = 0.0
         self.collision_vel = np.zeros(3)
         self.collision_angvel = np.zeros(3)
+        # device fast path (rigid_update_device): set by UpdateObstacles for
+        # the current step, consumed by body_velocity_field/ComputeForces;
+        # host mirrors are refreshed from the packed per-step read
+        self._dev_rigid: Optional[dict] = None
 
     # -- checkpointing -----------------------------------------------------
 
@@ -124,7 +201,7 @@ class Obstacle:
         after restore (io/checkpoint.py)."""
         state = {}
         for k, v in self.__dict__.items():
-            if k == "sim" or isinstance(v, jax.Array):
+            if k in ("sim", "_dev_rigid") or isinstance(v, jax.Array):
                 continue
             if k.endswith("_cache"):
                 continue
@@ -136,6 +213,7 @@ class Obstacle:
         self.sim = None
         self.chi = None
         self.udef = None
+        self._dev_rigid = None
 
     # -- geometry ---------------------------------------------------------
 
@@ -154,6 +232,35 @@ class Obstacle:
             self.sim.grid.shape + (3,), self.sim.dtype
         )
 
+    # -- device fast path --------------------------------------------------
+
+    def supports_device_update(self) -> bool:
+        """True when the rigid update has no host-only branch this step
+        (collision latch active -> host path; subclasses add their own
+        vetoes, e.g. StefanFish roll correction)."""
+        return self.collision_counter <= 0
+
+    def rigid_state_vec(self) -> np.ndarray:
+        """Host mirrors -> (RIGID_STATE,) input for rigid_update_device."""
+        return np.concatenate(
+            [self.transVel, self.angVel, self.position, self.absPos,
+             self.centerOfMass, self.quaternion]
+        )
+
+    def apply_rigid_pack(self, row: np.ndarray) -> None:
+        """(RIGID_PACK,) output of rigid_update_device -> host mirrors."""
+        row = np.asarray(row, np.float64)
+        self.transVel = row[0:3]
+        self.angVel = row[3:6]
+        self.position = row[6:9]
+        self.absPos = row[9:12]
+        self.centerOfMass = row[12:15]
+        self.quaternion = row[15:19]
+        if row[19] > 0:
+            self.mass = float(row[19])
+            self.J = row[20:29].reshape(3, 3)
+        self._dev_rigid = None
+
     # -- rigid-body dynamics ----------------------------------------------
 
     def body_velocity_field(self) -> jnp.ndarray:
@@ -163,25 +270,27 @@ class Obstacle:
         memoizes per (step, rigid state): penalization and the force pass
         consume the same field each step."""
         s = self.sim
-        tag = (s.step, tuple(self.transVel), tuple(self.angVel),
-               tuple(self.centerOfMass))
+        dev = self._dev_rigid
+        if dev is not None and dev["step"] == s.step:
+            # device fast path: rigid state from this step's on-device update
+            tag = (s.step, "dev")
+            cm, ut, om = dev["cm"], dev["trans"], dev["ang"]
+        else:
+            tag = (s.step, tuple(self.transVel), tuple(self.angVel),
+                   tuple(self.centerOfMass))
+            dtype = s.dtype
+            cm = jnp.asarray(self.centerOfMass, dtype)
+            ut = jnp.asarray(self.transVel, dtype)
+            om = jnp.asarray(self.angVel, dtype)
         cached = getattr(self, "_ubody_cache", None)
         if cached is not None and cached[0] == tag:
             return cached[1]
-        dtype = s.dtype
         fn = getattr(s, "_ubody_fn", None)
         if fn is not None:
-            field = fn(
-                self.udef,
-                jnp.asarray(self.centerOfMass, dtype),
-                jnp.asarray(self.transVel, dtype),
-                jnp.asarray(self.angVel, dtype),
-            )
+            field = fn(self.udef, cm, ut, om)
         else:
-            x = s.grid.cell_centers(dtype)
-            r = x - jnp.asarray(self.centerOfMass, dtype)
-            om = jnp.asarray(self.angVel, dtype)
-            ut = jnp.asarray(self.transVel, dtype)
+            x = s.grid.cell_centers(s.dtype)
+            r = x - cm
             field = ut + jnp.cross(jnp.broadcast_to(om, r.shape), r) + self.udef
         self._ubody_cache = (tag, field)
         return field
@@ -395,7 +504,7 @@ def log_forces(logger, i: int, time: float, ob) -> None:
 
 
 def update_penalization_forces(obstacles, penal_force_fn, vel_new, vel_old,
-                               dt, dtype) -> None:
+                               dt, dtype) -> jnp.ndarray:
     """Attach per-obstacle momentum-balance force/torque ON THE BODY
     (reference kernelFinalizePenalizationForce, main.cpp:13913-13938) —
     the negative of the momentum the penalization injects into the fluid,
@@ -403,8 +512,17 @@ def update_penalization_forces(obstacles, penal_force_fn, vel_new, vel_old,
     Computed every step like the reference.  The (n_obs, 6) result stays
     a device array — rows are attached as lazy slices so the hot loop
     never blocks on a host transfer; consumers that read ob.penal_force
-    trigger the (tiny) conversion themselves."""
-    cms = jnp.asarray(np.stack([ob.centerOfMass for ob in obstacles]), dtype)
+    trigger the (tiny) conversion themselves.  Returns the (n_obs, 6)
+    device array so the fast path can fold it into the step's single
+    packed read.  CMs come from the device rigid state when this step ran
+    rigid_update_device (host mirrors are one update behind there)."""
+    def _cm(ob):
+        d = ob._dev_rigid
+        if d is not None and d["step"] == ob.sim.step:
+            return d["cm"]
+        return jnp.asarray(ob.centerOfMass, dtype)
+
+    cms = jnp.stack([_cm(ob) for ob in obstacles])
     PF = -penal_force_fn(
         vel_new, vel_old, tuple(ob.chi for ob in obstacles),
         jnp.asarray(dt, dtype), cms,
@@ -412,3 +530,4 @@ def update_penalization_forces(obstacles, penal_force_fn, vel_new, vel_old,
     for i, ob in enumerate(obstacles):
         ob.penal_force = PF[i, :3]
         ob.penal_torque = PF[i, 3:]
+    return PF

@@ -237,6 +237,10 @@ class StefanFish(Obstacle):
 
     # -- rigid-body override: roll correction ------------------------------
 
+    def supports_device_update(self) -> bool:
+        # roll correction mutates angVel on host right after the 6x6 solve
+        return super().supports_device_update() and not self.bCorrectRoll
+
     def compute_velocities(self, moments) -> None:
         super().compute_velocities(moments)
         if not self.bCorrectRoll:

@@ -18,11 +18,13 @@ from cup3d_tpu.models.base import (
     momentum_integrals,
     pack_forces,
     pack_moments,
+    rigid_update_device,
     store_force_qoi,
     unpack_forces,
     unpack_moments,
     update_penalization_forces,
     vel_unit,
+    vel_unit_dev,
 )
 from cup3d_tpu.ops.penalization import (
     penalize,
@@ -32,6 +34,16 @@ from cup3d_tpu.sim.data import SimulationData
 from cup3d_tpu.sim.operators import Operator
 
 _EPS = 1e-6
+
+
+def _device_step(s) -> bool:
+    """True when this step's rigid update ran on device (single obstacle,
+    rigid_update_device): QoI join the step's single packed read."""
+    return (
+        len(s.obstacles) == 1
+        and s.obstacles[0]._dev_rigid is not None
+        and s.obstacles[0]._dev_rigid["step"] == s.step
+    )
 
 
 class CreateObstacles(Operator):
@@ -62,7 +74,13 @@ class CreateObstacles(Operator):
 
 class UpdateObstacles(Operator):
     """chi-weighted fluid momenta -> 6x6 solve -> rigid-body update
-    (reference UpdateObstacles, main.cpp:13812-13837)."""
+    (reference UpdateObstacles, main.cpp:13812-13837).
+
+    Single-obstacle fast path: when the update has no host-only branch
+    (no collision latch, no roll correction) the whole chain — moments,
+    6x6 solve, position/quaternion update — runs on device
+    (rigid_update_device) and the result joins the step's single packed
+    QoI read instead of blocking here (~75 ms/read on the tunneled TPU)."""
 
     def __init__(self, sim: SimulationData):
         super().__init__(sim)
@@ -75,16 +93,31 @@ class UpdateObstacles(Operator):
                 ]
             )
         )
+        self._rigid = jax.jit(rigid_update_device)
 
     def __call__(self, dt):
         s = self.sim
         cms = jnp.asarray(
             np.stack([ob.centerOfMass for ob in s.obstacles]), s.dtype
         )
-        M = np.asarray(
-            self._moments(tuple(ob.chi for ob in s.obstacles),
+        M = self._moments(tuple(ob.chi for ob in s.obstacles),
                           s.state["vel"], cms)
-        )
+        if len(s.obstacles) == 1 and s.obstacles[0].supports_device_update():
+            ob = s.obstacles[0]
+            out = self._rigid(
+                M[0],
+                jnp.asarray(ob.rigid_state_vec(), s.dtype),
+                jnp.asarray(ob.bForcedInSimFrame),
+                jnp.asarray(ob.bBlockRotation),
+                jnp.asarray(s.uinf, s.dtype),
+                jnp.asarray(dt, s.dtype),
+            )
+            ob._dev_rigid = {"step": s.step, "trans": out[0:3],
+                             "ang": out[3:6], "cm": out[12:15]}
+            ob._ubody_cache = None
+            s.pending_parts.append(("rigid", out))
+            return
+        M = np.asarray(M)
         for ob, row in zip(s.obstacles, M):
             ob.compute_velocities(unpack_moments(row))
             ob.update(dt)
@@ -131,10 +164,12 @@ class Penalization(Operator):
             vel_old, s.state["chi"], ubody,
             jnp.asarray(s.lambda_penal, s.dtype), jnp.asarray(dt, s.dtype),
         )
-        update_penalization_forces(
+        PF = update_penalization_forces(
             s.obstacles, self._penal_force, s.state["vel"], vel_old, dt,
             s.dtype,
         )
+        if _device_step(s):
+            s.pending_parts.append(("penal", PF.reshape(-1)))
 
 
 class ComputeForces(Operator):
@@ -160,6 +195,16 @@ class ComputeForces(Operator):
 
     def __call__(self, dt):
         s = self.sim
+        if _device_step(s):
+            ob = s.obstacles[0]
+            d = ob._dev_rigid
+            F = self._forces(
+                (ob.chi,), s.state["p"], s.state["vel"], d["cm"][None],
+                (ob.body_velocity_field(),), (ob.udef,),
+                vel_unit_dev(d["trans"])[None],
+            )
+            s.pending_parts.append(("forces", F.reshape(-1)))
+            return
         cms = jnp.asarray(
             np.stack([ob.centerOfMass for ob in s.obstacles]), s.dtype
         )

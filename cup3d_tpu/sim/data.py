@@ -53,6 +53,10 @@ class SimulationData:
 
         self.obstacles: List = []  # filled by the obstacle factory
         self.MeshChanged = True
+        # device fast path: (name, device array) QoI produced during the
+        # step, concatenated and fetched in ONE host read at the end of
+        # advance() (the tunneled TPU costs ~75 ms per blocking read)
+        self.pending_parts: List = []
 
         self.logger = BufferedLogger(cfg.path4serialization)
         self.profiler = Profiler()

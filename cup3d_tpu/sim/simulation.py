@@ -26,6 +26,9 @@ class Simulation:
         self.sim = SimulationData(cfg)
         self.pipeline: List[ops.Operator] = []
         self._max_u = jax.jit(diag.max_velocity)
+        # max|u| fetched in the previous step's packed read (fast path):
+        # saves the blocking read at the top of calc_max_timestep
+        self._umax_next: float | None = None
 
     # -- setup (reference init(), main.cpp:15163-15178) --------------------
 
@@ -77,7 +80,10 @@ class Simulation:
         """CFL dt with diffusive cap and log ramp-up (main.cpp:15254-15305)."""
         s, cfg = self.sim, self.cfg
         h = s.grid.h
-        umax = float(self._max_u(s.state["vel"], s.uinf_device()))
+        if self._umax_next is not None:
+            umax, self._umax_next = self._umax_next, None
+        else:
+            umax = float(self._max_u(s.state["vel"], s.uinf_device()))
         if umax > cfg.uMax_allowed:
             s.logger.flush()
             raise RuntimeError(
@@ -140,8 +146,49 @@ class Simulation:
         for op in self.pipeline:
             with s.profiler(op.name):
                 op(dt)
+        if s.pending_parts:
+            with s.profiler("SyncQoI"):
+                self._consume_step_pack()
         s.step += 1
         s.time += dt
+
+    def _consume_step_pack(self) -> None:
+        """Fetch every device QoI the step produced (rigid state, forces,
+        penalization forces) plus max|u| for the next dt in ONE packed
+        host read — the step's only blocking device sync (fast path;
+        see models/base.rigid_update_device)."""
+        import jax.numpy as jnp
+
+        from cup3d_tpu.models.base import (
+            log_forces, store_force_qoi, unpack_forces,
+        )
+
+        s = self.sim
+        parts = s.pending_parts
+        s.pending_parts = []
+        parts.append(
+            ("umax",
+             self._max_u(s.state["vel"], s.uinf_device()).reshape(1))
+        )
+        # pack in the solver dtype: a forced f32 cast would silently
+        # truncate the rigid trajectory in a float64 configuration
+        pack = jnp.concatenate([p[1].astype(s.dtype) for p in parts])
+        vals = np.asarray(pack, np.float64)  # the single blocking read
+        ob = s.obstacles[0] if s.obstacles else None
+        off = 0
+        for name, arr in parts:
+            seg = vals[off:off + arr.shape[0]]
+            off += arr.shape[0]
+            if name == "rigid":
+                ob.apply_rigid_pack(seg)
+            elif name == "penal":
+                ob.penal_force = seg[:3]
+                ob.penal_torque = seg[3:]
+            elif name == "forces":
+                store_force_qoi(ob, unpack_forces(seg))
+                log_forces(s.logger, 0, s.time, ob)
+            elif name == "umax":
+                self._umax_next = float(seg[0])
 
     def simulate(self) -> None:
         s, cfg = self.sim, self.cfg

@@ -70,3 +70,40 @@ def test_momentum_integrals_recover_rigid_motion():
 def test_unknown_obstacle_type_raises():
     with pytest.raises(ValueError, match="unknown obstacle"):
         make_sim("dodecahedron radius=0.1")
+
+
+def test_device_fast_path_matches_host():
+    """The single-sync device rigid update (models/base.rigid_update_device)
+    must reproduce the host 6x6-solve path: same velocities, trajectory,
+    quaternion, forces, and flow field (f32 round-trip tolerance)."""
+
+    def run(force_host):
+        s = make_sim(
+            "sphere radius=0.12 xpos=0.4 ypos=0.25 zpos=0.25",
+            nsteps=6, tend=0.0, dt=2e-3,
+        )
+        if force_host:
+            s.sim.obstacles[0].supports_device_update = lambda: False
+        s.sim.state["vel"] = s.sim.state["vel"].at[..., 0].add(0.25)
+        s.simulate()
+        return s
+
+    fast, host = run(False), run(True)
+    of, oh = fast.sim.obstacles[0], host.sim.obstacles[0]
+    assert not of._dev_rigid  # consumed by the packed read
+    np.testing.assert_allclose(of.transVel, oh.transVel, rtol=1e-5, atol=1e-7)
+    # angVel of a barely-rotating sphere is f32 noise (~4e-5): compare
+    # absolutely at the noise floor, not relatively
+    np.testing.assert_allclose(of.angVel, oh.angVel, atol=5e-6)
+    np.testing.assert_allclose(of.position, oh.position, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(of.quaternion, oh.quaternion, atol=1e-6)
+    np.testing.assert_allclose(of.centerOfMass, oh.centerOfMass, atol=1e-6)
+    np.testing.assert_allclose(of.force, oh.force, rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(of.penal_force), np.asarray(oh.penal_force),
+        rtol=1e-4, atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fast.sim.state["vel"]), np.asarray(host.sim.state["vel"]),
+        atol=1e-5,
+    )
