@@ -112,6 +112,35 @@ def block_cg_tiles_fast(b: jnp.ndarray, iters: int, shift=0.0,
     return block_cg_tiles_pallas(b, iters, shift, interpret)
 
 
+def cg_tiles_lanes(bt: jnp.ndarray, iters: int, shift=0.0) -> jnp.ndarray:
+    """getZ on batch-last tiles (bs, bs, bs, T) — the kernel's native
+    layout.  The lane-resident Krylov solve (krylov.make_laplacian_lanes)
+    keeps every field in this layout, so the per-application
+    (nb,8,8,8) <-> (8,8,8,nb) transposes of ``block_cg_tiles_pallas``
+    vanish (measured: they were ~55% of the BiCGSTAB iteration on v5e).
+    Off-TPU it falls back to the jnp reference (with the transposes)."""
+    n = bt.shape[-1]
+    if not use_pallas():
+        from cup3d_tpu.ops.krylov import block_cg_tiles_reference
+
+        b = jnp.moveaxis(bt, -1, 0)
+        z = block_cg_tiles_reference(b, iters, shift)
+        return jnp.moveaxis(z, 0, -1)
+    shift_vec = jnp.broadcast_to(
+        jnp.asarray(shift, bt.dtype), (1, 1, 1, n)
+    )
+    T = min(TILE_T, n)
+    n_pad = -(-n // T) * T
+    if n_pad != n:
+        bt = jnp.concatenate(
+            [bt, jnp.zeros(bt.shape[:-1] + (n_pad - n,), bt.dtype)], axis=-1
+        )
+        shift_vec = jnp.concatenate(
+            [shift_vec, jnp.zeros((1, 1, 1, n_pad - n), bt.dtype)], axis=-1
+        )
+    return _cg_tiles_pallas(bt, shift_vec, iters)[..., :n]
+
+
 def block_cg_tiles_pallas(b: jnp.ndarray, iters: int, shift=0.0,
                           interpret: bool = False) -> jnp.ndarray:
     bs = b.shape[-1]

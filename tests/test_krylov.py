@@ -98,3 +98,54 @@ def test_simulation_with_iterative_solver(tmp_path):
         for v in (tmp_path / "div.txt").read_text().splitlines()[-1].split()
     ]
     assert div_last[3] < 5e-3  # max|div u| after iterative projection
+
+
+# -- lane-resident layout (to_lanes / make_laplacian_lanes) ------------------
+
+
+def test_lanes_roundtrip():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, 16, 24)).astype(np.float32))
+    t = krylov.to_lanes(x)
+    assert t.shape == (8, 8, 8, (32 // 8) * (16 // 8) * (24 // 8))
+    np.testing.assert_array_equal(np.asarray(krylov.from_lanes(t, x.shape)),
+                                  np.asarray(x))
+
+
+@pytest.mark.parametrize("bc", [BC.periodic, BC.wall, BC.freespace])
+def test_lanes_laplacian_matches_dense(bc):
+    g = UniformGrid((32, 16, 24), (1.0, 0.5, 0.75), (bc,) * 3)
+    A = krylov.make_laplacian(g)
+    At = krylov.make_laplacian_lanes(g)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal(g.shape).astype(np.float32))
+    want = np.asarray(A(x))
+    got = np.asarray(krylov.from_lanes(At(krylov.to_lanes(x)), g.shape))
+    # f32 summation-order noise scales with inv_h^2 * |x|
+    np.testing.assert_allclose(got, want, atol=3e-6 * np.abs(want).max())
+
+
+def test_lanes_laplacian_mixed_bcs():
+    g = UniformGrid((16, 24, 32), (0.5, 0.75, 1.0),
+                    (BC.periodic, BC.wall, BC.periodic))
+    A = krylov.make_laplacian(g)
+    At = krylov.make_laplacian_lanes(g)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal(g.shape).astype(np.float32))
+    want = np.asarray(A(x))
+    got = np.asarray(krylov.from_lanes(At(krylov.to_lanes(x)), g.shape))
+    np.testing.assert_allclose(got, want, atol=3e-6 * np.abs(want).max())
+
+
+def test_lanes_solver_matches_dense_path():
+    g = _grid(BC.periodic, n=32)
+    rng = np.random.default_rng(3)
+    rhs = jnp.asarray(rng.standard_normal(g.shape).astype(np.float32))
+    rhs = rhs - jnp.mean(rhs)
+    p_lanes = krylov.build_iterative_solver(g, tol_abs=1e-7, tol_rel=1e-6)(rhs)
+    p_dense = krylov._build_iterative_solver_dense(
+        g, tol_abs=1e-7, tol_rel=1e-6)(rhs)
+    scale = float(jnp.max(jnp.abs(p_dense))) + 1e-30
+    np.testing.assert_allclose(
+        np.asarray(p_lanes) / scale, np.asarray(p_dense) / scale, atol=2e-5
+    )
