@@ -100,6 +100,9 @@ def bench_fish_uniform():
             "bFixFrameOfRef=1 heightProfile=danio widthProfile=stefan"
         ),
         verbose=False, freqDiagnostics=0,
+        # depth-2 pipelined stepping: the packed QoI read of step N lands
+        # during step N+1's device work (config.py `pipelined`)
+        pipelined=True,
     )
     sim = Simulation(cfg)
     sim.init()

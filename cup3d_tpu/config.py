@@ -44,6 +44,12 @@ class SimulationConfig:
     rampup: int = 100  # CFL log-ramp steps
     step_2nd_start: int = 2  # enable 2nd-order pressure after this step
     uMax_allowed: float = 10.0  # runaway-velocity abort
+    # depth-2 pipelined stepping (new capability, no reference analogue):
+    # the per-step QoI pack is fetched one step late so its device->host
+    # transfer overlaps the next step's device work.  dt then derives from
+    # max|u| one step older than the reference's policy (CFL slack absorbs
+    # it); requires a single obstacle without PID/roll corrections.
+    pipelined: bool = False
 
     # -- fluid (main.cpp:15357-15363) --
     nu: float = 1e-3

@@ -91,6 +91,21 @@ def quat_multiply_dev(a, b):
     )
 
 
+def quat_to_rot_dev(q):
+    """Device twin of quat_to_rot."""
+    w, x, y, z = q[0], q[1], q[2], q[3]
+    return jnp.stack(
+        [
+            jnp.stack([1 - 2 * (y * y + z * z), 2 * (x * y - w * z),
+                       2 * (x * z + w * y)]),
+            jnp.stack([2 * (x * y + w * z), 1 - 2 * (x * x + z * z),
+                       2 * (y * z - w * x)]),
+            jnp.stack([2 * (x * z - w * y), 2 * (y * z + w * x),
+                       1 - 2 * (x * x + y * y)]),
+        ]
+    )
+
+
 def quat_integrate_dev(q, omega, dt):
     """Device twin of quat_integrate (exact exponential map)."""
     n = jnp.linalg.norm(omega)
@@ -247,7 +262,17 @@ class Obstacle:
              self.centerOfMass, self.quaternion]
         )
 
-    def apply_rigid_pack(self, row: np.ndarray) -> None:
+    def rigid_state_dev(self, dtype) -> jnp.ndarray:
+        """(RIGID_STATE,) device input for rigid_update_device: chains from
+        the previous step's device output when it exists (pipelined mode
+        keeps the rigid trajectory device-resident), else uploads the host
+        mirrors."""
+        d = self._dev_rigid
+        if d is not None:
+            return d["pack"][:RIGID_STATE]
+        return jnp.asarray(self.rigid_state_vec(), dtype)
+
+    def apply_rigid_pack(self, row: np.ndarray, clear_dev: bool = True) -> None:
         """(RIGID_PACK,) output of rigid_update_device -> host mirrors."""
         row = np.asarray(row, np.float64)
         self.transVel = row[0:3]
@@ -259,7 +284,8 @@ class Obstacle:
         if row[19] > 0:
             self.mass = float(row[19])
             self.J = row[20:29].reshape(3, 3)
-        self._dev_rigid = None
+        if clear_dev:
+            self._dev_rigid = None
 
     # -- rigid-body dynamics ----------------------------------------------
 

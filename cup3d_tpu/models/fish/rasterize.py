@@ -25,16 +25,6 @@ import jax
 import jax.numpy as jnp
 
 _WEPS = 1e-10  # degenerate-section guard (reference: width,height >= 1e-10)
-_CHUNK = 16    # segments processed per loop trip (see rasterize_points)
-
-
-def _pad_rows(arr, n, fill):
-    """Pad the leading axis of arr to length n with ``fill``."""
-    k = n - arr.shape[0]
-    if k == 0:
-        return arr
-    pad = jnp.full((k,) + arr.shape[1:], fill, arr.dtype)
-    return jnp.concatenate([arr, pad], axis=0)
 
 
 def _segment_distance(p, seg):
@@ -126,54 +116,27 @@ def rasterize_points(points, midline, position, rot):
     shape = p.shape[:-1]
 
     nm = midline["r"].shape[0]
-    nseg = nm - 1
     big = jnp.asarray(1e10, dtype)
     d0 = jnp.full(shape, big)
     u0 = jnp.zeros(shape + (3,), dtype)
 
-    # per-segment endpoint arrays, padded to whole chunks with degenerate
-    # far-away segments (union-min unaffected).  Processing _CHUNK segments
-    # per loop trip amortizes the per-kernel launch cost that a
-    # one-segment-at-a-time fori_loop pays ~Nm times (measured ~40 ms per
-    # rasterization at 128^3 on a v5e; chunked it is launch-bound no more).
-    # pad geometry must stay f32-finite through _segment_distance: a unit
-    # O(1)-thick segment at 1e9 gives a ~1e9 positive (outside) distance
-    # with no overflow (1e9/_WEPS squared would be inf -> NaN min-poison)
-    npad = -(-nseg // _CHUNK) * _CHUNK
-    segs = {}
-    for name, key in (("r", "r"), ("v", "v"), ("nor", "nor"),
-                      ("vnor", "vnor"), ("bin", "bin"), ("vbin", "vbin")):
-        arr = midline[key]
-        f0, f1 = (1e9, 2e9) if name == "r" else (0.0, 0.0)
-        segs[name + "0"] = _pad_rows(arr[:-1], npad, f0)
-        segs[name + "1"] = _pad_rows(arr[1:], npad, f1)
-    for name, key in (("w", "width"), ("h", "height")):
-        arr = midline[key]
-        segs[name + "0"] = _pad_rows(arr[:-1], npad, 1.0)
-        segs[name + "1"] = _pad_rows(arr[1:], npad, 1.0)
-    segs = {k: v.reshape((npad // _CHUNK, _CHUNK) + v.shape[1:])
-            for k, v in segs.items()}
-
-    per_seg = jax.vmap(lambda s: _segment_distance(p, s))
-
-    def body(ci, carry):
+    def body(ss, carry):
         dmin, udef = carry
-        chunk = {
-            k: jax.lax.dynamic_index_in_dim(v, ci, 0, keepdims=False)
-            for k, v in segs.items()
-        }
-        d, ud = per_seg(chunk)  # d: (_CHUNK, ...), ud: (_CHUNK, ..., 3)
-        i = jnp.argmin(d, axis=0)
-        dbest = jnp.min(d, axis=0)
-        ubest = jnp.take_along_axis(
-            ud, jnp.broadcast_to(i[None, ..., None], (1,) + i.shape + (3,)),
-            axis=0,
-        )[0]
-        closer = dbest < dmin
-        return (jnp.minimum(dbest, dmin),
-                jnp.where(closer[..., None], ubest, udef))
+        seg = {}
+        for name, key in (("r", "r"), ("v", "v"), ("nor", "nor"),
+                          ("vnor", "vnor"), ("bin", "bin"), ("vbin", "vbin")):
+            arr = midline[key]
+            seg[name + "0"] = jax.lax.dynamic_slice(arr, (ss, 0), (1, 3))[0]
+            seg[name + "1"] = jax.lax.dynamic_slice(arr, (ss + 1, 0), (1, 3))[0]
+        for name, key in (("w", "width"), ("h", "height")):
+            arr = midline[key]
+            seg[name + "0"] = jax.lax.dynamic_slice(arr, (ss,), (1,))[0]
+            seg[name + "1"] = jax.lax.dynamic_slice(arr, (ss + 1,), (1,))[0]
+        d, ud = _segment_distance(p, seg)
+        closer = d < dmin
+        return jnp.minimum(d, dmin), jnp.where(closer[..., None], ud, udef)
 
-    dmin, udef_body = jax.lax.fori_loop(0, npad // _CHUNK, body, (d0, u0))
+    dmin, udef_body = jax.lax.fori_loop(0, nm - 1, body, (d0, u0))
     sdf = -dmin  # reference convention: positive inside
     udef_comp = jnp.einsum("...c,dc->...d", udef_body, rot)
     return sdf, udef_comp

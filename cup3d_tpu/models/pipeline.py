@@ -65,11 +65,16 @@ class CreateObstacles(Operator):
     def _update_uinf(self):
         """Frame-fixed swimming: uinf counteracts the tracked obstacle's
         translational velocity (ObstacleVector::updateUinf,
-        main.cpp:8507-8519)."""
+        main.cpp:8507-8519).  In pipelined mode the value stays device-
+        resident (the host mirror trails one step, feeding only logs)."""
         s = self.sim
         fixed = [ob for ob in s.obstacles if ob.bFixFrameOfRef]
-        if fixed:
-            s.uinf = -np.mean([ob.transVel for ob in fixed], axis=0)
+        if not fixed:
+            return
+        s.uinf = -np.mean([ob.transVel for ob in fixed], axis=0)
+        devs = [ob._dev_rigid for ob in fixed]
+        if s.cfg.pipelined and all(d is not None for d in devs):
+            s._uinf_dev = -sum(d["trans"] for d in devs) / len(devs)
 
 
 class UpdateObstacles(Operator):
@@ -97,23 +102,30 @@ class UpdateObstacles(Operator):
 
     def __call__(self, dt):
         s = self.sim
-        cms = jnp.asarray(
-            np.stack([ob.centerOfMass for ob in s.obstacles]), s.dtype
-        )
+
+        def cm_of(ob):
+            # pipelined chaining: the fresh CM lives on device; the host
+            # mirror trails one step and would shift the moment reference
+            d = ob._dev_rigid
+            if d is not None:
+                return d["cm"]
+            return jnp.asarray(ob.centerOfMass, s.dtype)
+
+        cms = jnp.stack([cm_of(ob) for ob in s.obstacles])
         M = self._moments(tuple(ob.chi for ob in s.obstacles),
                           s.state["vel"], cms)
         if len(s.obstacles) == 1 and s.obstacles[0].supports_device_update():
             ob = s.obstacles[0]
             out = self._rigid(
                 M[0],
-                jnp.asarray(ob.rigid_state_vec(), s.dtype),
+                ob.rigid_state_dev(s.dtype),
                 jnp.asarray(ob.bForcedInSimFrame),
                 jnp.asarray(ob.bBlockRotation),
-                jnp.asarray(s.uinf, s.dtype),
+                s.uinf_device(),
                 jnp.asarray(dt, s.dtype),
             )
             ob._dev_rigid = {"step": s.step, "trans": out[0:3],
-                             "ang": out[3:6], "cm": out[12:15]}
+                             "ang": out[3:6], "cm": out[12:15], "pack": out}
             ob._ubody_cache = None
             s.pending_parts.append(("rigid", out))
             return

@@ -55,8 +55,11 @@ class SimulationData:
         self.MeshChanged = True
         # device fast path: (name, device array) QoI produced during the
         # step, concatenated and fetched in ONE host read at the end of
-        # advance() (the tunneled TPU costs ~75 ms per blocking read)
+        # advance() (the tunneled TPU costs ~75 ms per blocking read);
+        # pipelined mode defers that read one step so the transfer overlaps
+        # the next step's device work
         self.pending_parts: List = []
+        self._uinf_dev = None
 
         self.logger = BufferedLogger(cfg.path4serialization)
         self.profiler = Profiler()
@@ -100,4 +103,9 @@ class SimulationData:
         return self.state["chi"]
 
     def uinf_device(self) -> jnp.ndarray:
+        # pipelined mode keeps uinf device-resident (CreateObstacles sets
+        # it from the device transVel); the host self.uinf then only feeds
+        # logs and checkpoints
+        if self._uinf_dev is not None:
+            return self._uinf_dev
         return jnp.asarray(self.uinf, dtype=self.dtype)

@@ -29,12 +29,34 @@ class Simulation:
         # max|u| fetched in the previous step's packed read (fast path):
         # saves the blocking read at the top of calc_max_timestep
         self._umax_next: float | None = None
+        # fast-path QoI packs awaiting their host read; depth 1 normally
+        # (read at end of the producing step), depth 2 when cfg.pipelined
+        # (read one step late by a worker thread, overlapping the transfer
+        # with the next step's device work)
+        self._pack_queue: List[dict] = []
+        self._reader = None  # pipelined-mode consumer thread
 
     # -- setup (reference init(), main.cpp:15163-15178) --------------------
 
     def init(self) -> None:
         self._setup_operators()
         self._add_obstacles()
+        if self.cfg.pipelined:
+            if len(self.sim.obstacles) > 1:
+                raise ValueError(
+                    "pipelined mode requires a single obstacle (the device "
+                    "rigid chain has no multi-body collision path) — run "
+                    "without -pipelined"
+                )
+            for ob in self.sim.obstacles:
+                if (getattr(ob, "bCorrectPosition", False)
+                        or getattr(ob, "bCorrectPositionZ", False)
+                        or getattr(ob, "bCorrectRoll", False)):
+                    raise ValueError(
+                        "pipelined mode is a throughput mode: PID/roll-"
+                        "corrected obstacles need current host mirrors "
+                        "every step — run without -pipelined"
+                    )
         ops.initial_conditions(self.sim)
 
     def _setup_operators(self) -> None:
@@ -81,7 +103,11 @@ class Simulation:
         s, cfg = self.sim, self.cfg
         h = s.grid.h
         if self._umax_next is not None:
-            umax, self._umax_next = self._umax_next, None
+            umax = self._umax_next
+            if not self.cfg.pipelined:
+                self._umax_next = None
+            # pipelined: keep the latest consumed max|u| (the reader thread
+            # may still be in flight); staleness is bounded by two steps
         else:
             umax = float(self._max_u(s.state["vel"], s.uinf_device()))
         if umax > cfg.uMax_allowed:
@@ -116,10 +142,12 @@ class Simulation:
     def _maybe_dump_save(self) -> None:
         s = self.sim
         if s.cadence.dump_due(s.time, s.step):
+            self.flush_packs()  # host mirrors current before output
             self.dump_fields()
         if s.cadence.save_due(s.step):
             from cup3d_tpu.io.checkpoint import save_checkpoint
 
+            self.flush_packs()
             with s.profiler("Checkpoint"):
                 save_checkpoint(self)
 
@@ -148,20 +176,58 @@ class Simulation:
                 op(dt)
         if s.pending_parts:
             with s.profiler("SyncQoI"):
-                self._consume_step_pack()
+                self._emit_step_pack()
+                if self.cfg.pipelined:
+                    # overlap the blocking host read with the next step's
+                    # dispatch: a worker thread performs ONLY the transfer
+                    # (no shared-state writes); the main thread applies the
+                    # fetched values here, so mirrors never tear.  Joining
+                    # is instant in steady state — the worker had a full
+                    # step of wall-clock to finish one transfer.
+                    self._join_reader()
+                    if len(self._pack_queue) >= 2:
+                        entry = self._pack_queue.pop(0)
+                        import threading
+
+                        th = threading.Thread(
+                            target=self._fetch_entry, args=(entry,)
+                        )
+                        th.start()
+                        self._reader = (th, entry)
+                else:
+                    while self._pack_queue:
+                        self._consume_pack(self._pack_queue.pop(0))
         s.step += 1
         s.time += dt
 
-    def _consume_step_pack(self) -> None:
-        """Fetch every device QoI the step produced (rigid state, forces,
-        penalization forces) plus max|u| for the next dt in ONE packed
-        host read — the step's only blocking device sync (fast path;
-        see models/base.rigid_update_device)."""
-        import jax.numpy as jnp
+    @staticmethod
+    def _fetch_entry(entry: dict) -> None:
+        """Worker-thread body: blocking device->host transfer only."""
+        try:
+            entry["vals"] = np.asarray(entry["pack"], np.float64)
+        except BaseException as e:  # re-raised on the main thread at join
+            entry["err"] = e
 
-        from cup3d_tpu.models.base import (
-            log_forces, store_force_qoi, unpack_forces,
-        )
+    def _join_reader(self) -> None:
+        """Join the in-flight transfer and apply it on the main thread
+        (re-raising any transfer failure instead of losing it)."""
+        if self._reader is None:
+            return
+        th, entry = self._reader
+        self._reader = None
+        th.join()
+        if "err" in entry:
+            raise entry["err"]
+        self._consume_pack(entry)
+
+    def _emit_step_pack(self) -> None:
+        """Concatenate every device QoI the step produced (rigid state,
+        forces, penalization forces) plus max|u| for a later dt into ONE
+        device vector and start its device->host transfer (fast path; see
+        models/base.rigid_update_device).  Non-pipelined runs read it back
+        immediately (advance); pipelined runs read it one step later, so
+        the transfer overlaps the next step's device work."""
+        import jax.numpy as jnp
 
         s = self.sim
         parts = s.pending_parts
@@ -173,22 +239,50 @@ class Simulation:
         # pack in the solver dtype: a forced f32 cast would silently
         # truncate the rigid trajectory in a float64 configuration
         pack = jnp.concatenate([p[1].astype(s.dtype) for p in parts])
-        vals = np.asarray(pack, np.float64)  # the single blocking read
+        try:
+            pack.copy_to_host_async()
+        except Exception:
+            pass  # experimental platforms may lack async copies
+        self._pack_queue.append(
+            {"layout": [(n, a.shape[0]) for n, a in parts], "pack": pack,
+             "time": s.time}
+        )
+
+    def _consume_pack(self, entry: dict) -> None:
+        """Read one emitted pack (or reuse the worker's fetch) and refresh
+        host mirrors — always called from the main thread."""
+        from cup3d_tpu.models.base import (
+            log_forces, store_force_qoi, unpack_forces,
+        )
+
+        s = self.sim
+        vals = entry.get("vals")
+        if vals is None:
+            vals = np.asarray(entry["pack"], np.float64)
         ob = s.obstacles[0] if s.obstacles else None
         off = 0
-        for name, arr in parts:
-            seg = vals[off:off + arr.shape[0]]
-            off += arr.shape[0]
+        for name, size in entry["layout"]:
+            seg = vals[off:off + size]
+            off += size
             if name == "rigid":
-                ob.apply_rigid_pack(seg)
+                # pipelined mode chains the rigid state on device across
+                # steps: the (trailing) mirrors must not clobber it
+                ob.apply_rigid_pack(seg, clear_dev=not self.cfg.pipelined)
             elif name == "penal":
                 ob.penal_force = seg[:3]
                 ob.penal_torque = seg[3:]
             elif name == "forces":
                 store_force_qoi(ob, unpack_forces(seg))
-                log_forces(s.logger, 0, s.time, ob)
+                log_forces(s.logger, 0, entry["time"], ob)
             elif name == "umax":
                 self._umax_next = float(seg[0])
+
+    def flush_packs(self) -> None:
+        """Drain pending QoI packs so host mirrors are current — called
+        before dumps, checkpoints, and at run end (pipelined mode)."""
+        self._join_reader()
+        while self._pack_queue:
+            self._consume_pack(self._pack_queue.pop(0))
 
     def simulate(self) -> None:
         s, cfg = self.sim, self.cfg
@@ -201,4 +295,5 @@ class Simulation:
             done_n = cfg.nsteps > 0 and s.step >= cfg.nsteps
             if done_t or done_n:
                 break
+        self.flush_packs()
         s.logger.flush()
