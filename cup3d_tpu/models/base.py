@@ -272,6 +272,17 @@ class Obstacle:
             return d["pack"][:RIGID_STATE]
         return jnp.asarray(self.rigid_state_vec(), dtype)
 
+    def pos_rot_device(self, dtype):
+        """(position, rotation-matrix) as device arrays for rasterization:
+        from the device rigid pack when pipelined chaining is active (the
+        host mirror trails one step there), else uploaded host mirrors."""
+        d = self._dev_rigid
+        if self.sim.cfg.pipelined and d is not None:
+            pack = d["pack"]
+            return pack[6:9], quat_to_rot_dev(pack[15:19])
+        return (jnp.asarray(self.position, dtype),
+                jnp.asarray(quat_to_rot(self.quaternion), dtype))
+
     def apply_rigid_pack(self, row: np.ndarray, clear_dev: bool = True) -> None:
         """(RIGID_PACK,) output of rigid_update_device -> host mirrors."""
         row = np.asarray(row, np.float64)

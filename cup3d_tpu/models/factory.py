@@ -18,6 +18,10 @@ def make_obstacles(sim, specs: List[Dict[str, str]]) -> List:
             from cup3d_tpu.models.fish import StefanFish
 
             obstacles.append(StefanFish(sim, spec))
+        elif kind == "naca":
+            from cup3d_tpu.models.naca import Naca
+
+            obstacles.append(Naca(sim, spec))
         else:
             raise ValueError(f"unknown obstacle type {spec['type']!r}")
     return obstacles

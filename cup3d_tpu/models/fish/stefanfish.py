@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cup3d_tpu.models.base import Obstacle, quat_to_rot, quat_to_rot_dev
+from cup3d_tpu.models.base import Obstacle, quat_to_rot
 from cup3d_tpu.models.fish.curvature import CurvatureDefinedFishData
 from cup3d_tpu.models.fish.rasterize import rasterize_midline, rasterize_points
 from cup3d_tpu.models.fish.shapes import compute_widths_heights
@@ -81,7 +81,6 @@ class StefanFish(Obstacle):
         if not self._is_blocks:
             nw = int(np.ceil(1.25 * self.length / h)) + 8
             self._window_shape = tuple(min(nw, n) for n in sim.grid.shape)
-        self._win_origin = np.zeros(3)
 
     # -- geometry pipeline (Fish::create, main.cpp:10952-10958) ------------
 
@@ -212,32 +211,17 @@ class StefanFish(Obstacle):
         h = grid.h
         dtype = self.sim.dtype
         half = 0.5 * np.asarray(self._window_shape) * h
-        dev = self._dev_rigid
-        if self.sim.cfg.pipelined and dev is not None:
-            # pipelined mode: the rigid state lives on device (the host
-            # mirror trails one step); window snap, position, and rotation
-            # all consume the device pack directly — no host read
-            pos = dev["pack"][6:9]
-            rot = quat_to_rot_dev(dev["pack"][15:19])
-            idx0 = jnp.clip(
-                jnp.floor((pos - jnp.asarray(half, dtype)) / h).astype(jnp.int32),
-                0,
-                jnp.asarray(
-                    np.asarray(grid.shape) - self._window_shape, jnp.int32
-                ),
-            )
-            origin = idx0.astype(dtype) * h
-            starts = (idx0[0], idx0[1], idx0[2])
-        else:
-            pos = jnp.asarray(self.position, dtype)
-            rot = jnp.asarray(quat_to_rot(self.quaternion), dtype)
-            # snap the window to the grid around the fish center
-            idx0 = np.floor((self.position - half) / h).astype(int)
-            idx0 = np.clip(idx0, 0, np.asarray(grid.shape) - self._window_shape)
-            self._win_idx0 = idx0
-            self._win_origin = idx0 * h
-            origin = jnp.asarray(self._win_origin, dtype)
-            starts = tuple(idx0)
+        # rigid state from the device pack in pipelined mode (host mirrors
+        # trail one step there), else uploaded mirrors; the window snap is
+        # traced either way so both branches share one code path
+        pos, rot = self.pos_rot_device(dtype)
+        idx0 = jnp.clip(
+            jnp.floor((pos - jnp.asarray(half, dtype)) / h).astype(jnp.int32),
+            0,
+            jnp.asarray(np.asarray(grid.shape) - self._window_shape, jnp.int32),
+        )
+        origin = idx0.astype(dtype) * h
+        starts = (idx0[0], idx0[1], idx0[2])
         sdf_w, udef_w = rasterize_midline(
             origin, h, self._window_shape, self._midline_device(), pos, rot,
         )

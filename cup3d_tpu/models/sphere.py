@@ -22,11 +22,7 @@ class Sphere(Obstacle):
     def rasterize(self, t: float):
         grid = self.sim.grid
         x = grid.cell_centers(self.sim.dtype)
-        dev = self._dev_rigid
-        if self.sim.cfg.pipelined and dev is not None:
-            pos = dev["pack"][6:9]  # device position (pipelined chaining)
-        else:
-            pos = jnp.asarray(self.position, self.sim.dtype)
+        pos, _ = self.pos_rot_device(self.sim.dtype)
         d = jnp.linalg.norm(x - pos, axis=-1)
         sdf = self.radius - d  # > 0 inside
         return sdf, None

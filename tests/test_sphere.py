@@ -135,3 +135,34 @@ def test_pipelined_mode_matches_default():
         np.asarray(pipe.sim.state["vel"]), np.asarray(ref.sim.state["vel"]),
         atol=1e-6,
     )
+
+
+def test_naca_chi_volume_and_drag():
+    """Naca obstacle (reference NacaMidlineData + PutNacaOnBlocks,
+    main.cpp:12749-12810, 11740-11926): chi volume ~ extrusion height x
+    airfoil area, and a held airfoil in a stream feels +x drag."""
+    from cup3d_tpu.models.fish.midline import midline_arc_grid
+    from cup3d_tpu.models.fish.shapes import naca_width
+
+    s = make_sim(
+        "naca L=0.3 tRatio=0.3 HoverL=0.5 xpos=0.4 ypos=0.25 zpos=0.25 "
+        "bForcedInSimFrame=1",
+        nsteps=10, tend=0.0, dt=2e-3,
+    )
+    s.pipeline[0](0.0)  # CreateObstacles
+    vol = float(jnp.sum(s.sim.state["chi"])) * s.sim.grid.h ** 3
+    rs = midline_arc_grid(0.3, s.sim.grid.h)
+    area = 2.0 * np.trapezoid(naca_width(0.3, 0.3, rs), rs)
+    exact = area * 2 * (0.5 * 0.3 * 0.5)  # area x full extrusion height
+    assert abs(vol - exact) / exact < 0.25  # mollified body, coarse h
+    ob = s.sim.obstacles[0]
+    # SDF sign: inside at the thickest point, outside past the z cap
+    sdf, _ = ob.rasterize(0.0)
+    gi = tuple(int(v / s.sim.grid.h) for v in (0.36, 0.25, 0.25))
+    assert float(sdf[gi]) > 0
+    go = tuple(int(v / s.sim.grid.h) for v in (0.36, 0.25, 0.45))
+    assert float(sdf[go]) < 0
+    s.sim.state["vel"] = s.sim.state["vel"].at[..., 0].add(0.3)
+    s.simulate()
+    assert np.all(np.isfinite(ob.force))
+    assert ob.force[0] > 0.0  # stream drag
