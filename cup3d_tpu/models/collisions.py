@@ -113,12 +113,16 @@ def prevent_colliding_obstacles(
     gradchi_fn,
     xc: jnp.ndarray,
     dt: float,
+    precheck_counts=None,
 ) -> bool:
     """Detect overlapping obstacle pairs and resolve them with an elastic
     impulse; latch the collision velocities for one step.  Returns whether
     any collision fired (reference sim.bCollision).
 
     gradchi_fn: chi -> (..., 3) gradient on the driver's layout.
+    precheck_counts: optional {(i, j): float} overlap-cell counts fetched
+    by the caller (drivers batch them into another host read); when given,
+    the per-pair blocking ``overlap_count`` read is skipped.
     """
     n_obs = len(obstacles)
     if n_obs < 2:
@@ -136,7 +140,12 @@ def prevent_colliding_obstacles(
     for i in range(n_obs):
         for j in range(i + 1, n_obs):
             oi, oj = obstacles[i], obstacles[j]
-            if float(overlap_count(oi.chi, oj.chi)) < _TOL_CELLS:
+            cnt = (
+                precheck_counts[(i, j)]
+                if precheck_counts is not None
+                else float(overlap_count(oi.chi, oj.chi))
+            )
+            if cnt < _TOL_CELLS:
                 continue
             s = pair_overlap_summary(
                 oi.chi, oj.chi, grad(i), grad(j),

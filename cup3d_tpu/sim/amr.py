@@ -93,6 +93,11 @@ class AMRSimulation:
         from cup3d_tpu.io.dump import OutputCadence
 
         self._cadence = OutputCadence(cfg.tdump, cfg.fdump, cfg.saveFreq)
+        # end-of-step packed QoI read (forces, penalization forces, max|u|):
+        # one blocking transfer instead of one per quantity (~75 ms each on
+        # the tunneled TPU; same scheme as sim/simulation.py)
+        self._pending_parts: List = []
+        self._umax_next = None
         self._rebuild()
         self._alloc_fields()
 
@@ -423,7 +428,10 @@ class AMRSimulation:
     def calc_max_timestep(self) -> float:
         cfg = self.cfg
         hmin = float(self.grid.h.min())
-        umax = float(self._maxu(self.state["vel"], self.uinf_device()))
+        if self._umax_next is not None:
+            umax, self._umax_next = self._umax_next, None
+        else:
+            umax = float(self._maxu(self.state["vel"], self.uinf_device()))
         if umax > cfg.uMax_allowed:
             self.logger.flush()
             raise RuntimeError(f"runaway velocity: max|u|={umax:.3g}")
@@ -498,15 +506,40 @@ class AMRSimulation:
             s["vel"] = self._advdiff(s["vel"], dt_j, uinf)
         if self.obstacles:
             with self.profiler("UpdateObstacles"):
+                n_obs = len(self.obstacles)
                 cms = jnp.asarray(
                     np.stack([ob.centerOfMass for ob in self.obstacles]),
                     self.dtype,
                 )
-                M = np.asarray(
-                    self._moments(
-                        tuple(ob.chi for ob in self.obstacles), s["vel"], cms
+                M_dev = self._moments(
+                    tuple(ob.chi for ob in self.obstacles), s["vel"], cms
+                ).reshape(-1)
+                # piggyback the collision pre-check (overlap cell count per
+                # pair) on the moments read: one transfer serves both
+                pairs = [
+                    (i, j) for i in range(n_obs) for j in range(i + 1, n_obs)
+                ]
+                if pairs:
+                    from cup3d_tpu.models.collisions import overlap_count
+
+                    cnts = jnp.stack(
+                        [
+                            overlap_count(
+                                self.obstacles[i].chi, self.obstacles[j].chi
+                            ).astype(self.dtype)
+                            for i, j in pairs
+                        ]
                     )
-                )
+                    vals = np.asarray(jnp.concatenate([M_dev, cnts]),
+                                      np.float64)
+                    precheck = {
+                        p: float(v)
+                        for p, v in zip(pairs, vals[n_obs * 19:])
+                    }
+                else:
+                    vals = np.asarray(M_dev, np.float64)
+                    precheck = {}
+                M = vals[: n_obs * 19].reshape(n_obs, 19)
                 for ob, row in zip(self.obstacles, M):
                     ob.compute_velocities(unpack_moments(row))
                     ob.update(dt)
@@ -522,16 +555,18 @@ class AMRSimulation:
                         self._gradchi,
                         self._xc,
                         dt,
+                        precheck_counts=precheck,
                     )
                 vel_old = s["vel"]
                 s["vel"] = self._penalize(
                     vel_old, s["chi"], self._body_velocity(),
                     jnp.asarray(self.lambda_penal, self.dtype), dt_j,
                 )
-                update_penalization_forces(
+                PF = update_penalization_forces(
                     self.obstacles, self._penal_force, s["vel"], vel_old,
                     dt, self.dtype,
                 )
+                self._pending_parts.append(("penal", PF.reshape(-1)))
         if self.cfg.bFixMassFlux:
             with self.profiler("FixMassFlux"):
                 self._fix_mass_flux()
@@ -575,8 +610,41 @@ class AMRSimulation:
                     f"{float(d['enstrophy']):.8e}"
                     f" {float(d['dissipation_rate']):.8e}\n",
                 )
+        with self.profiler("SyncQoI"):
+            self._consume_step_pack()
         self.step_idx += 1
         self.time += dt
+
+    def _consume_step_pack(self):
+        """ONE blocking host read for everything the step produced
+        (penalization forces, force QoI, next-dt max|u|) — the AMR twin of
+        sim/simulation.py's packed read."""
+        from cup3d_tpu.models.base import (
+            log_forces, store_force_qoi, unpack_forces,
+        )
+
+        parts = self._pending_parts
+        self._pending_parts = []
+        parts.append(
+            ("umax",
+             self._maxu(self.state["vel"], self.uinf_device()).reshape(1))
+        )
+        pack = jnp.concatenate([p[1].astype(self.dtype) for p in parts])
+        vals = np.asarray(pack, np.float64)
+        off = 0
+        for name, arr in parts:
+            seg = vals[off:off + arr.shape[0]]
+            off += arr.shape[0]
+            if name == "penal":
+                for i, ob in enumerate(self.obstacles):
+                    ob.penal_force = seg[6 * i:6 * i + 3]
+                    ob.penal_torque = seg[6 * i + 3:6 * i + 6]
+            elif name == "forces":
+                for i, ob in enumerate(self.obstacles):
+                    store_force_qoi(ob, unpack_forces(seg[13 * i:13 * (i + 1)]))
+                    log_forces(self.logger, i, self.time, ob)
+            elif name == "umax":
+                self._umax_next = float(seg[0])
 
     def _fix_mass_flux(self):
         u_target = 2.0 / 3.0 * self.cfg.uMax_forced
@@ -603,16 +671,13 @@ class AMRSimulation:
             np.stack([vel_unit(ob.transVel) for ob in self.obstacles]),
             self.dtype,
         )
-        F = np.asarray(
-            self._forces(
-                tuple(ob.chi for ob in self.obstacles), s["p"], s["vel"],
-                cms, tuple(self._obstacle_ubody(ob) for ob in self.obstacles),
-                tuple(ob.udef for ob in self.obstacles), vunits,
-            )
+        F = self._forces(
+            tuple(ob.chi for ob in self.obstacles), s["p"], s["vel"],
+            cms, tuple(self._obstacle_ubody(ob) for ob in self.obstacles),
+            tuple(ob.udef for ob in self.obstacles), vunits,
         )
-        for i, (ob, row) in enumerate(zip(self.obstacles, F)):
-            store_force_qoi(ob, unpack_forces(row))
-            log_forces(self.logger, i, self.time, ob)
+        # joins the end-of-step packed read (_consume_step_pack)
+        self._pending_parts.append(("forces", F.reshape(-1)))
 
     def simulate(self):
         cfg = self.cfg
