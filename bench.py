@@ -79,9 +79,10 @@ def _time_steps(advance, calc_dt, warmup: int, iters: int,
         return (time.perf_counter() - t0) / iters
 
 
-def bench_fish_uniform():
-    """BASELINE config #2: 128^3 uniform self-propelled fish, iterative
-    Poisson at 1e-6/1e-4."""
+def bench_fish_uniform(n_default: int = 128):
+    """BASELINE config #2: uniform self-propelled fish, iterative Poisson
+    at 1e-6/1e-4 (CUP3D_BENCH_CONFIG=fish256 runs it at 256^3, the closest
+    single-chip stand-in for the 512^3-equivalent north-star case)."""
     import jax.numpy as jnp
 
     from cup3d_tpu.config import SimulationConfig
@@ -89,7 +90,7 @@ def bench_fish_uniform():
     from cup3d_tpu.ops.projection import pressure_rhs
     from cup3d_tpu.sim.simulation import Simulation
 
-    n = _scaled(128)
+    n = _scaled(n_default)
     bpd = n // 8
     cfg = SimulationConfig(
         bpdx=bpd, bpdy=bpd, bpdz=bpd, levelMax=1, levelStart=0, extent=1.0,
@@ -273,6 +274,76 @@ def bench_spectral():
             "n": n}
 
 
+def bench_channel():
+    """BASELINE config #5: forced channel (uMax_forced acceleration +
+    FixMassFlux profile correction, main.cpp:15235-15240), wall-bounded in
+    y, 128x64x64."""
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.sim.simulation import Simulation
+
+    nx = _scaled(128)
+    cfg = SimulationConfig(
+        bpdx=nx // 8, bpdy=nx // 16, bpdz=nx // 16, levelMax=1, levelStart=0,
+        extent=2.0, CFL=0.4, nu=1e-3, tend=0.0, nsteps=10**9, rampup=0,
+        BC_y="wall", uMax_forced=0.5, bFixMassFlux=True,
+        poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+        verbose=False, freqDiagnostics=0,
+    )
+    sim = Simulation(cfg)
+    sim.init()
+    iters = 10
+    wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=3,
+                       iters=iters, tag="channel")
+    from cup3d_tpu.ops import diagnostics as diag
+
+    _, div_max = diag.divergence_norms(sim.sim.grid, sim.sim.state["vel"])
+    n_cells = nx * (nx // 2) * (nx // 2)
+    return {
+        "cells_per_s": n_cells / wall,
+        "wall_per_step_s": round(wall, 4),
+        "div_max": float(div_max),
+        "n": nx,
+    }
+
+
+def bench_amr_tgv():
+    """BASELINE config #3: Taylor-Green on a 2-level static AMR forest
+    (refined center octant), iterative solver at 1e-6/1e-4."""
+    import jax.numpy as jnp
+
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    # bpd=8 yields a genuinely mixed 2-level mesh (the vortex cores refine,
+    # the low-vorticity bands stay coarse); viable since the gather tables
+    # travel as jit arguments rather than HLO constants (grid/blocks.py)
+    bpd = max(2, _scaled(128) // 16)
+    cfg = SimulationConfig(
+        bpdx=bpd, bpdy=bpd, bpdz=bpd, levelMax=2, levelStart=0,
+        extent=float(2 * np.pi), CFL=0.4, nu=1e-3, tend=0.0, nsteps=10**9,
+        rampup=0, Rtol=1.8, Ctol=0.05,  # refine only the vortex cores
+        poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+        initCond="taylorGreen", verbose=False, freqDiagnostics=0,
+    )
+    sim = AMRSimulation(cfg)
+    sim.init()
+    # STATIC 2-level AMR (the config's definition): freeze the converged
+    # mesh so the timed window has no re-layouts/recompiles
+    sim.adapt_enabled = False
+    iters = 10
+    wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=3,
+                       iters=iters, tag="amr_tgv")
+    total, div_max = sim._divnorms(sim.state["vel"])
+    nb = sim.grid.nb
+    return {
+        "wall_per_step_s": round(wall, 4),
+        "cells_per_s": nb * sim.grid.bs**3 / wall,
+        "blocks": int(nb),
+        "levels": sorted(set(int(l) for l in np.asarray(sim.grid.level))),
+        "div_max": float(div_max),
+    }
+
+
 def bench_two_fish_amr():
     """The run.sh acceptance case (BASELINE config #4), levelMax=3: two
     StefanFish on the dynamically adapting forest."""
@@ -315,16 +386,17 @@ def bench_two_fish_amr():
 
 def main():
     which = os.environ.get("CUP3D_BENCH_CONFIG", "all")
-    if which not in ("fish", "tgv", "spectral", "amr", "all"):
+    if which not in ("fish", "fish256", "tgv", "spectral", "amr",
+                     "channel", "amr_tgv", "all"):
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "vs_baseline": 0,
                           "error": f"unknown CUP3D_BENCH_CONFIG {which!r}"}))
         return
     secondary = {}
     fish = None
-    if which in ("fish", "all"):
+    if which in ("fish", "fish256", "all"):
         try:
-            fish = bench_fish_uniform()
+            fish = bench_fish_uniform(256 if which == "fish256" else 128)
         except Exception as e:  # pragma: no cover - platform dependent
             fish = None
             secondary["fish_error"] = {
@@ -336,10 +408,16 @@ def main():
         ("tgv_iterative", bench_tgv_iterative),
         ("spectral", bench_spectral),
         ("two_fish_amr", bench_two_fish_amr),
+        ("channel", bench_channel),
+        ("amr_tgv", bench_amr_tgv),
     ):
         sel = {"tgv_iterative": "tgv", "spectral": "spectral",
-               "two_fish_amr": "amr"}[key]
-        if which not in (sel, "all"):
+               "two_fish_amr": "amr", "channel": "channel",
+               "amr_tgv": "amr_tgv"}[key]
+        # channel/amr_tgv are selectable-only (keep the default "all" run
+        # bounded for CI-style drivers); their numbers live in VALIDATION.md
+        in_all = key in ("tgv_iterative", "spectral", "two_fish_amr")
+        if which != sel and not (which == "all" and in_all):
             continue
         try:
             secondary[key] = fn()

@@ -96,6 +96,44 @@ class LabTables:
         return _assemble_vec_comp(field, self, bs, comp)
 
 
+class _HashableArrays:
+    """Hashable identity for static numpy index arrays carried in a
+    pytree's aux_data (jit cache keys must be hashable)."""
+
+    __slots__ = ("arrays", "_key")
+
+    def __init__(self, arrays):
+        self.arrays = tuple(arrays)
+        self._key = tuple(a.tobytes() for a in self.arrays)
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return (isinstance(other, _HashableArrays)
+                and self._key == other._key)
+
+
+# Registered as a pytree so jitted functions can take the tables as
+# ARGUMENTS instead of closure constants: closure-captured arrays are
+# embedded into the lowered HLO, which at a few thousand blocks makes the
+# compile payload tens-to-hundreds of MB (observed as HTTP 413 from the
+# tunneled TPU's remote-compile endpoint) and re-embeds on every re-layout.
+jax.tree_util.register_pytree_node(
+    LabTables,
+    lambda t: (
+        (t.g_idx, t.g_w, t.g_sign, t.mask_coarse, t.s_idx, t.s_w, t.s_sign,
+         t.interp_w),
+        (t.width, _HashableArrays(t.ghost_xyz), t.any_coarse),
+    ),
+    lambda aux, ch: LabTables(
+        width=aux[0], ghost_xyz=aux[1].arrays, g_idx=ch[0], g_w=ch[1],
+        g_sign=ch[2], mask_coarse=ch[3], s_idx=ch[4], s_w=ch[5],
+        s_sign=ch[6], interp_w=ch[7], any_coarse=aux[2],
+    ),
+)
+
+
 class BlockGrid:
     """Geometry + topology of one AMR forest snapshot.
 

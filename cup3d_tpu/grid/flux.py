@@ -21,6 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -43,6 +44,18 @@ class FluxTables:
 
     def apply(self, out: jnp.ndarray, fluxes: jnp.ndarray) -> jnp.ndarray:
         return apply_flux_correction(out, fluxes, self)
+
+
+# pytree registration: see grid/blocks.py LabTables — tables travel as jit
+# arguments, not closure constants embedded in the HLO
+jax.tree_util.register_pytree_node(
+    FluxTables,
+    lambda t: ((t.tgt_cell, t.tgt_flux, t.src_flux, t.inv_hc), (t.ncorr,)),
+    lambda aux, ch: FluxTables(
+        tgt_cell=ch[0], tgt_flux=ch[1], src_flux=ch[2], inv_hc=ch[3],
+        ncorr=aux[0],
+    ),
+)
 
 
 def build_flux_tables(grid) -> FluxTables:

@@ -252,20 +252,24 @@ def build_amr_poisson_solver(
     def wmean(x):
         return jnp.sum(x * vol) / vol_total
 
-    def A(x):
-        return laplacian_blocks(grid, x, tab, flux_tab)
-
     def M(r):
         # per-block CG with the block's own h^2 (poisson_kernels getZ,
         # main.cpp:14617-14746); blocks are already bs^3 tiles
         return krylov.block_cg_tiles(-h2 * r, precond_iters)
 
-    def solve(rhs, x0=None):
+    def solve(rhs, x0=None, tab_arg=None, flux_arg=None):
+        # callers under jit pass the tables as traced ARGUMENTS so they
+        # are runtime buffers, not constants embedded in the lowered HLO
+        # (see grid/blocks.py pytree registration); the builder's own
+        # tables are the fallback for direct use
+        t = tab if tab_arg is None else tab_arg
+        ft = flux_tab if flux_arg is None else flux_arg
         b = rhs - wmean(rhs)
         if pmask is not None:
             b = b * pmask
         x, rnorm, k = krylov.bicgstab(
-            A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter
+            lambda x_: laplacian_blocks(grid, x_, t, ft), b, M=M, x0=x0,
+            tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter,
         )
         x = x - wmean(x)
         return x * pmask if pmask is not None else x
@@ -345,9 +349,9 @@ def project_blocks(
     rhs = pressure_rhs_blocks(grid, vel, dt, tab, flux_tab, chi, udef)
     if second_order and p_init is not None:
         rhs = rhs - laplacian_blocks(grid, p_init, tab, flux_tab)
-        p = p_init + solver(rhs, None)
+        p = p_init + solver(rhs, None, tab_arg=tab, flux_arg=flux_tab)
     else:
-        p = solver(rhs, p_init)
+        p = solver(rhs, p_init, tab_arg=tab, flux_arg=flux_tab)
     plab = tab.assemble_scalar(p, bs)
     gp = grad_blocks(grid, plab, tab.width)
     return vel - dt * gp, p

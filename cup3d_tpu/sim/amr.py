@@ -98,6 +98,10 @@ class AMRSimulation:
         # the tunneled TPU; same scheme as sim/simulation.py)
         self._pending_parts: List = []
         self._umax_next = None
+        # static-AMR mode: freeze the (converged) mesh — no tagging, no
+        # re-layout, no recompiles (BASELINE config #3 is a static 2-level
+        # run; dynamic runs leave this True)
+        self.adapt_enabled = True
         self._rebuild()
         self._alloc_fields()
 
@@ -175,6 +179,22 @@ class AMRSimulation:
             self._real_mask = None
         self._geom = geom
 
+        # The jitted step functions take the gather tables and cell-center
+        # arrays as trailing ARGUMENTS (LabTables/FluxTables are registered
+        # pytrees, grid/blocks.py): closure-captured arrays are embedded
+        # into the lowered HLO as constants, which at a few thousand blocks
+        # made the compile payload exceed the TPU tunnel's request limit
+        # (HTTP 413) and re-embedded everything on every adaptation
+        # re-layout.  The sharded forest's duck-typed tables are not
+        # pytrees, so that path keeps the closure style (its scale is
+        # bounded by per-device shards anyway).
+        def jit_bound(fn, *bound):
+            if self.forest is not None:
+                jf = jax.jit(lambda *a: fn(*a, *bound))
+                return jf
+            jf = jax.jit(fn)
+            return lambda *a: jf(*a, *bound)
+
         if cfg.implicitDiffusion:
             from cup3d_tpu.ops import diffusion as dif
 
@@ -182,101 +202,114 @@ class AMRSimulation:
                 geom, tol_abs=cfg.diffusionTol, tol_rel=cfg.diffusionTolRel,
                 tab=self._tab1, flux_tab=self._ftab,
             )
-            self._advdiff = jax.jit(
-                lambda vel, dt, uinf: dif.implicit_step_blocks(
-                    geom, vel, dt, self.nu, uinf, self._tab3, helm
-                )
+            self._advdiff = jit_bound(
+                lambda vel, dt, uinf, tab3: dif.implicit_step_blocks(
+                    geom, vel, dt, self.nu, uinf, tab3, helm
+                ),
+                self._tab3,
             )
         else:
-            self._advdiff = jax.jit(
-                lambda vel, dt, uinf: amr_ops.rk3_step_blocks(
-                    geom, vel, dt, self.nu, uinf, self._tab3, self._ftab
-                )
+            self._advdiff = jit_bound(
+                lambda vel, dt, uinf, tab3, ftab: amr_ops.rk3_step_blocks(
+                    geom, vel, dt, self.nu, uinf, tab3, ftab
+                ),
+                self._tab3, self._ftab,
             )
-        self._project = jax.jit(
-            lambda vel, dt, chi, udef, p_old: amr_ops.project_blocks(
-                geom, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
+        self._project = jit_bound(
+            lambda vel, dt, chi, udef, p_old, tab1, ftab:
+            amr_ops.project_blocks(
+                geom, vel, dt, self._solver, tab1, ftab, chi, udef,
                 p_init=p_old,
-            )
+            ),
+            self._tab1, self._ftab,
         )
-        self._project_2nd = jax.jit(
-            lambda vel, dt, chi, udef, p_old: amr_ops.project_blocks(
-                geom, vel, dt, self._solver, self._tab1, self._ftab, chi, udef,
+        self._project_2nd = jit_bound(
+            lambda vel, dt, chi, udef, p_old, tab1, ftab:
+            amr_ops.project_blocks(
+                geom, vel, dt, self._solver, tab1, ftab, chi, udef,
                 p_init=p_old, second_order=True,
-            )
+            ),
+            self._tab1, self._ftab,
         )
         self._penalize = jax.jit(penalize)
-        self._penal_force = jax.jit(
-            lambda vn, vo, chis, dt, cms: per_obstacle_penalization_force(
-                vn, vo, chis, dt, self._vol, self._xc, cms
-            )
+        self._penal_force = jit_bound(
+            lambda vn, vo, chis, dt, cms, vol, xc:
+            per_obstacle_penalization_force(vn, vo, chis, dt, vol, xc, cms),
+            self._vol, self._xc,
         )
         # ALL obstacles' force QoI in one (n_obs, 13) host read per step
-        self._forces = jax.jit(
-            lambda chis, p, vel, cms, ubodies, udefs, vunits: jnp.stack(
+        self._forces = jit_bound(
+            lambda chis, p, vel, cms, ubodies, udefs, vunits, tab1, xc:
+            jnp.stack(
                 [
                     pack_forces(
                         amr_ops.force_integrals_blocks(
-                            geom, self._tab1, self._xc, c, p, vel, self.nu,
+                            geom, tab1, xc, c, p, vel, self.nu,
                             cms[i], ubodies[i], udefs[i], vunits[i]
                         )
                     )
                     for i, c in enumerate(chis)
                 ]
-            )
+            ),
+            self._tab1, self._xc,
         )
         # per-obstacle rigid+deformation velocity field from the cached
         # device cell centers (avoids Obstacle.body_velocity_field's host
         # rebuild of cell_centers every step)
-        self._ubody = jax.jit(
-            lambda udef, cm, ut, om: ut
-            + jnp.cross(jnp.broadcast_to(om, self._xc.shape), self._xc - cm)
-            + udef
+        self._ubody = jit_bound(
+            lambda udef, cm, ut, om, xc: ut
+            + jnp.cross(jnp.broadcast_to(om, xc.shape), xc - cm)
+            + udef,
+            self._xc,
         )
-        self._divnorms = jax.jit(
-            lambda vel: amr_ops.divergence_norms_blocks(geom, vel, self._tab1)
+        self._divnorms = jit_bound(
+            lambda vel, tab1: amr_ops.divergence_norms_blocks(geom, vel, tab1),
+            self._tab1,
         )
-        self._dissipation = jax.jit(
-            lambda vel: amr_ops.dissipation_blocks(geom, vel, self.nu, self._tab1)
+        self._dissipation = jit_bound(
+            lambda vel, tab1: amr_ops.dissipation_blocks(
+                geom, vel, self.nu, tab1
+            ),
+            self._tab1,
         )
-        self._gradchi = jax.jit(
-            lambda chi: amr_ops.grad_blocks(
-                geom, self._tab1.assemble_scalar(chi, g.bs), self._tab1.width
-            )
+        self._gradchi = jit_bound(
+            lambda chi, tab1: amr_ops.grad_blocks(
+                geom, tab1.assemble_scalar(chi, g.bs), tab1.width
+            ),
+            self._tab1,
         )
-        self._omega_mag = jax.jit(
-            lambda vel: jnp.sqrt(
+        self._omega_mag = jit_bound(
+            lambda vel, tab1: jnp.sqrt(
                 jnp.sum(
                     amr_ops.curl_blocks(
-                        geom, self._tab1.assemble_vector(vel, g.bs), self._tab1.width
+                        geom, tab1.assemble_vector(vel, g.bs), tab1.width
                     )
                     ** 2,
                     axis=-1,
                 )
-            )
+            ),
+            self._tab1,
         )
 
-        def scores(vel, chi):
-            vort = amr_ops.vorticity_score(geom, vel, self._tab1)
-            near_body = amr_ops.gradchi_mask(geom, chi, self._tab1)
-            return vort, near_body
+        self._scores = jit_bound(
+            lambda vel, chi, tab1: (
+                amr_ops.vorticity_score(geom, vel, tab1),
+                amr_ops.gradchi_mask(geom, chi, tab1),
+            ),
+            self._tab1,
+        )
 
-        self._scores = jax.jit(scores)
-
-        def moments(chis, vel, cms):
-            # one (n_obs, 19) transfer for all obstacles
-            return jnp.stack(
+        self._moments = jit_bound(
+            lambda chis, vel, cms, xc, vol: jnp.stack(
                 [
                     pack_moments(
-                        momentum_integrals_core(
-                            self._xc, self._vol, c, vel, cms[i]
-                        )
+                        momentum_integrals_core(xc, vol, c, vel, cms[i])
                     )
                     for i, c in enumerate(chis)
                 ]
-            )
-
-        self._moments = jax.jit(moments)
+            ),
+            self._xc, self._vol,
+        )
 
         def maxu(vel, uinf):
             return jnp.max(jnp.abs(vel + uinf))
@@ -496,7 +529,9 @@ class AMRSimulation:
         uinf = self.uinf_device()
 
         self._maybe_dump_save()
-        if self.step_idx < 10 or self.step_idx % ADAPT_EVERY == 0:
+        if self.adapt_enabled and (
+            self.step_idx < 10 or self.step_idx % ADAPT_EVERY == 0
+        ):
             with self.profiler("AdaptMesh"):
                 self.adapt_mesh()
 

@@ -54,3 +54,26 @@ def test_amr_grid_converges_onto_sphere(tmp_path):
     )
     s.simulate()
     assert bool(jnp.all(jnp.isfinite(s.state["vel"])))
+
+
+def test_amr_naca_runs(tmp_path):
+    """The Naca obstacle is layout-generic (its SDF evaluates at arbitrary
+    cell centers): the AMR driver refines onto the airfoil and steps."""
+    cfg = SimulationConfig(
+        bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=0,
+        extent=1.0, nu=1e-3, nsteps=2, rampup=0, dt=1e-3, tend=-1.0,
+        Rtol=1e9, Ctol=-1.0,
+        factory_content="naca L=0.3 tRatio=0.25 HoverL=0.6 xpos=0.5 "
+                        "ypos=0.5 zpos=0.5 bForcedInSimFrame=1",
+        verbose=False, path4serialization=str(tmp_path),
+    )
+    s = AMRSimulation(cfg)
+    s.init()
+    chi = np.asarray(s.state["chi"])
+    has_interface = ((chi > 0.01) & (chi < 0.99)).any(axis=(1, 2, 3))
+    assert has_interface.any()
+    finest = cfg.levelMax - 1
+    assert (s.grid.level[has_interface] == finest).all()
+    s.simulate()
+    assert bool(jnp.all(jnp.isfinite(s.state["vel"])))
+    assert np.isfinite(s.obstacles[0].force).all()
