@@ -219,6 +219,18 @@ class BlockGrid:
                 self._lab_cache[width] = self._build_lab_tables(width)
         return self._lab_cache[width]
 
+    def face_tables(self, width: int):
+        """Face-slab fast-path tables (grid/faces.py): block-granular
+        gathers + dense interpolation for axis-stencil operators.  Duck-
+        compatible with LabTables for every ops/amr_ops.py consumer."""
+        key = ("faces", width)
+        if key not in self._lab_cache:
+            from cup3d_tpu.grid.faces import build_face_tables
+
+            with jax.ensure_compile_time_eval():
+                self._lab_cache[key] = build_face_tables(self, width)
+        return self._lab_cache[key]
+
     def _cells_per_dim(self, l: int) -> np.ndarray:
         return np.array(
             [b * self.bs << l for b in self.tree.cfg.bpd], np.int64

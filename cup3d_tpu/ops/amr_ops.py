@@ -257,7 +257,7 @@ def build_amr_poisson_solver(
         # main.cpp:14617-14746); blocks are already bs^3 tiles
         return krylov.block_cg_tiles(-h2 * r, precond_iters)
 
-    def solve(rhs, x0=None, tab_arg=None, flux_arg=None):
+    def solve(rhs, x0=None, tab_arg=None, flux_arg=None, rnorm_ref=None):
         # callers under jit pass the tables as traced ARGUMENTS so they
         # are runtime buffers, not constants embedded in the lowered HLO
         # (see grid/blocks.py pytree registration); the builder's own
@@ -267,9 +267,14 @@ def build_amr_poisson_solver(
         b = rhs - wmean(rhs)
         if pmask is not None:
             b = b * pmask
+        if rnorm_ref is None:
+            # rel tolerance references the system's own RHS; warm-started
+            # callers pass the cold RHS norm (see krylov.bicgstab)
+            rnorm_ref = jnp.sqrt(jnp.sum(b * b, dtype=jnp.float32))
         x, rnorm, k = krylov.bicgstab(
             lambda x_: laplacian_blocks(grid, x_, t, ft), b, M=M, x0=x0,
             tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter,
+            rnorm_ref=rnorm_ref,
         )
         x = x - wmean(x)
         return x * pmask if pmask is not None else x
@@ -347,11 +352,16 @@ def project_blocks(
     """
     bs = grid.bs
     rhs = pressure_rhs_blocks(grid, vel, dt, tab, flux_tab, chi, udef)
+    # the warm/increment solves stop relative to the COLD system's RHS
+    # norm, so a good start can only cut iterations (krylov.bicgstab)
+    ref = jnp.sqrt(jnp.sum(rhs * rhs, dtype=jnp.float32))
     if second_order and p_init is not None:
         rhs = rhs - laplacian_blocks(grid, p_init, tab, flux_tab)
-        p = p_init + solver(rhs, None, tab_arg=tab, flux_arg=flux_tab)
+        p = p_init + solver(rhs, None, tab_arg=tab, flux_arg=flux_tab,
+                            rnorm_ref=ref)
     else:
-        p = solver(rhs, p_init, tab_arg=tab, flux_arg=flux_tab)
+        p = solver(rhs, p_init, tab_arg=tab, flux_arg=flux_tab,
+                   rnorm_ref=ref)
     plab = tab.assemble_scalar(p, bs)
     gp = grad_blocks(grid, plab, tab.width)
     return vel - dt * gp, p

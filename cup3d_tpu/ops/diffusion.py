@@ -233,9 +233,13 @@ def build_amr_helmholtz_solver(
                 return krylov.block_cg_tiles(shift * r, precond_iters,
                                              shift=shift)
 
+            # x0=b is a warm start: rel tolerance must reference the cold
+            # RHS norm or the good start tightens the target and costs
+            # iterations (krylov.bicgstab rnorm_ref)
             x, _, _ = krylov.bicgstab(
                 A, b, M=M, x0=b, tol_abs=tol_abs, tol_rel=tol_rel,
                 maxiter=maxiter,
+                rnorm_ref=jnp.sqrt(jnp.sum(b * b, dtype=jnp.float32)),
             )
             outs.append(x)
         return jnp.stack(outs, axis=-1)

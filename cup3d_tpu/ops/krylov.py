@@ -283,6 +283,7 @@ def bicgstab(
     tol_abs: float = 1e-6,
     tol_rel: float = 1e-4,
     maxiter: int = 1000,
+    rnorm_ref=None,
 ):
     """Preconditioned BiCGSTAB with breakdown re-seeding and best-x tracking
     (the reference's solve loop, main.cpp:14449-14604).  Returns
@@ -290,6 +291,14 @@ def bicgstab(
 
     Stopping matches the reference: ||r|| <= max(tol_abs, tol_rel*||r0||)
     (PoissonErrorTol/PoissonErrorTolRel, main.cpp:15364-15365).
+
+    ``rnorm_ref`` overrides the relative-tolerance reference norm.  A warm
+    start (x0 != 0, or the 2nd-order increment form) SHRINKS ||r0||, which
+    would tighten the target exactly when the start is good and make warm
+    solves cost MORE iterations than cold ones (measured 54 vs 44,
+    VERDICT r2 item 4).  Callers with a warm start pass the cold system's
+    RHS norm so the solve targets the same absolute quality as a cold
+    solve and a good start can only reduce iterations.
     """
     if M is None:
         M = lambda r: r
@@ -300,7 +309,8 @@ def bicgstab(
 
     r0 = b - apply_A(x0)
     rnorm0 = jnp.sqrt(_dot(r0, r0))
-    target = jnp.maximum(tol_abs, tol_rel * rnorm0)
+    ref = rnorm0 if rnorm_ref is None else rnorm_ref
+    target = jnp.maximum(tol_abs, tol_rel * ref)
     one = jnp.asarray(1.0, b.dtype)
 
     init = _BiCGState(
@@ -411,9 +421,11 @@ def build_iterative_solver(
         b = rhs - jnp.mean(rhs)
         bt = to_lanes(b, precond_bs)
         x0t = None if x0 is None else to_lanes(x0, precond_bs)
+        # rel tolerance always references the cold system's RHS norm so a
+        # warm start can only reduce iterations (see bicgstab docstring)
         xt, _, _ = bicgstab(
             A, bt, M=M, x0=x0t, tol_abs=tol_abs, tol_rel=tol_rel,
-            maxiter=maxiter,
+            maxiter=maxiter, rnorm_ref=jnp.sqrt(_dot(bt, bt)),
         )
         x = from_lanes(xt, rhs.shape)
         return x - jnp.mean(x)
@@ -436,7 +448,8 @@ def _build_iterative_solver_dense(
     def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         b = rhs - jnp.mean(rhs)
         x, _, _ = bicgstab(
-            A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter
+            A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel,
+            maxiter=maxiter, rnorm_ref=jnp.sqrt(_dot(b, b)),
         )
         return x - jnp.mean(x)
 

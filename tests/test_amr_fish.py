@@ -66,11 +66,10 @@ def test_divergence_gate(fish_sim):
     Brinkman penalization (the reference's div.txt is likewise dominated by
     the band; ComputeDivergence, main.cpp:8789-8919)."""
     sim = fish_sim
-    from cup3d_tpu.grid.blocks import assemble_vector_lab
     from cup3d_tpu.ops import amr_ops
 
     g = sim.grid
-    vlab = assemble_vector_lab(sim.state["vel"], sim._tab1, g.bs)
+    vlab = sim._tab1.assemble_vector(sim.state["vel"], g.bs)
     d = np.abs(np.asarray(amr_ops.div_blocks(g, vlab, sim._tab1.width)))
     assert np.all(np.isfinite(d))
     chi = np.asarray(sim.state["chi"])

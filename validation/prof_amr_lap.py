@@ -1,0 +1,184 @@
+"""Microbenchmark: where does the AMR Laplacian/lab-assembly time go on TPU?
+
+Builds the amr_tgv-style mixed 2-level forest (bpd=8 -> ~1400 blocks), then
+times on-device, steady state:
+  - laplacian_blocks per application
+  - lab assembly alone (assemble_scalar)
+  - face-ghost gather alone / scratch gather alone / upsample alone
+  - one BiCGSTAB iteration (2x laplacian + 2x getZ + dots)
+  - the uniform lane-layout Laplacian at the same cell count, for reference
+"""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.config import SimulationConfig
+from cup3d_tpu.sim.amr import AMRSimulation
+from cup3d_tpu.grid import blocks as B
+from cup3d_tpu.ops import amr_ops, krylov
+
+
+def _sync(r):
+    # forced scalar read: block_until_ready is unreliable on axon (chained
+    # dispatches report ready before running)
+    jnp.asarray(jax.tree_util.tree_leaves(r)[0]).reshape(-1)[0].item()
+
+
+def timeit(f, *a, n=20, warmup=8):
+    for _ in range(warmup):
+        r = f(*a)
+    _sync(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*a)
+    _sync(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    bpd = int(os.environ.get("PROF_BPD", "8"))
+    cfg = SimulationConfig(
+        bpdx=bpd, bpdy=bpd, bpdz=bpd, levelMax=2, levelStart=0,
+        extent=float(2 * np.pi), CFL=0.4, nu=1e-3, tend=0.0, nsteps=10**9,
+        rampup=0, Rtol=1.8, Ctol=0.05,
+        poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+        initCond="taylorGreen", verbose=False, freqDiagnostics=0,
+    )
+    sim = AMRSimulation(cfg)
+    sim.init()
+    sim.adapt_enabled = False
+    g = sim.grid
+    nb = g.nb
+    print(f"forest: nb={nb} levels={sorted(set(g.level.tolist()))} "
+          f"cells={nb * g.bs**3}")
+
+    tab = sim._tab1
+    ftab = sim._ftab
+    x = sim.state["p"] + jnp.asarray(
+        np.random.default_rng(0).standard_normal((nb, 8, 8, 8)), jnp.float32)
+
+    lap = jax.jit(lambda f, t, ft: amr_ops.laplacian_blocks(g, f, t, ft))
+    t_lap = timeit(lap, x, tab, ftab)
+    print(f"laplacian_blocks:      {t_lap*1e3:8.3f} ms "
+          f"({nb*512/t_lap/1e6:.1f} Mcell/s)")
+
+    asm = jax.jit(lambda f, t: t.assemble_scalar(f, g.bs))
+    t_asm = timeit(asm, x, tab)
+    print(f"assemble_scalar:       {t_asm*1e3:8.3f} ms")
+
+    # parts
+    def face_gather(f, t):
+        flat = jnp.concatenate([f.reshape(-1), jnp.zeros(1, f.dtype)])
+        return B._gather_comp(flat, t.g_idx, t.g_w)
+    t_fg = timeit(jax.jit(face_gather), x, tab)
+    print(f"  ghost gather (ng={tab.g_idx.shape[1]}x8): {t_fg*1e3:8.3f} ms")
+
+    def scratch_gather(f, t):
+        flat = jnp.concatenate([f.reshape(-1), jnp.zeros(1, f.dtype)])
+        return B._gather_comp(flat, t.s_idx, t.s_w)
+    t_sg = timeit(jax.jit(scratch_gather), x, tab)
+    print(f"  scratch gather (S^3={tab.s_idx.shape[1]}x8): {t_sg*1e3:8.3f} ms")
+
+    def upsample(f, t):
+        flat = jnp.concatenate([f.reshape(-1), jnp.zeros(1, f.dtype)])
+        sc = B._gather_comp(flat, t.s_idx, t.s_w)
+        S = t.interp_w.shape[1]
+        return B._upsample(sc.reshape(nb, S, S, S), t.interp_w)
+    t_up = timeit(jax.jit(upsample), x, tab)
+    print(f"  scratch+upsample:    {t_up*1e3:8.3f} ms")
+
+    # one BiCGSTAB iteration cost: fixed 5-iteration solve / 5
+    h2 = jnp.asarray((g.h**2).reshape(nb, 1, 1, 1), jnp.float32)
+
+    def M(r):
+        return krylov.block_cg_tiles(-h2 * r, 24)
+
+    def k_iters(b, t, ft, k):
+        A = lambda v: amr_ops.laplacian_blocks(g, v, t, ft)
+        return krylov.bicgstab(A, b, M=M, tol_abs=0.0, tol_rel=0.0, maxiter=k)
+    f5 = jax.jit(lambda b, t, ft: k_iters(b, t, ft, 5))
+    f10 = jax.jit(lambda b, t, ft: k_iters(b, t, ft, 10))
+    t5 = timeit(f5, x, tab, ftab, n=6, warmup=3)
+    t10 = timeit(f10, x, tab, ftab, n=6, warmup=3)
+    per_it = (t10 - t5) / 5
+    print(f"bicgstab per-iter:     {per_it*1e3:8.3f} ms "
+          f"({nb*512/per_it/1e6:.1f} Mcell/s-iter)")
+
+    t_getz = timeit(jax.jit(M), x)
+    print(f"getZ(24):              {t_getz*1e3:8.3f} ms")
+
+    # uniform reference at same cell count: n^3 ~ nb*512
+    n = int(round((nb * 512) ** (1 / 3) / 8) * 8)
+    from cup3d_tpu.grid.uniform import BC, UniformGrid
+    ug = UniformGrid((n, n, n), (1.0,) * 3, (BC.periodic,) * 3)
+    Au = krylov.make_laplacian_lanes(ug)
+    xu = krylov.to_lanes(jnp.asarray(
+        np.random.default_rng(1).standard_normal((n, n, n)), jnp.float32))
+    t_u = timeit(jax.jit(Au), xu)
+    print(f"uniform lanes lap n={n}: {t_u*1e3:8.3f} ms "
+          f"({n**3/t_u/1e6:.1f} Mcell/s)")
+
+
+def face_path():
+    """FaceTables fast-path timings on the same forest (run via
+    PROF_FACES=1)."""
+    bpd = int(os.environ.get("PROF_BPD", "8"))
+    cfg = SimulationConfig(
+        bpdx=bpd, bpdy=bpd, bpdz=bpd, levelMax=2, levelStart=0,
+        extent=float(2 * np.pi), CFL=0.4, nu=1e-3, tend=0.0, nsteps=10**9,
+        rampup=0, Rtol=1.8, Ctol=0.05,
+        poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+        initCond="taylorGreen", verbose=False, freqDiagnostics=0,
+    )
+    sim = AMRSimulation(cfg)
+    sim.init()
+    sim.adapt_enabled = False
+    g = sim.grid
+    nb = g.nb
+    print(f"forest: nb={nb} cells={nb * g.bs**3}")
+    ftab = sim._ftab
+    tab = g.face_tables(1)
+    tab3 = g.face_tables(3)
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((nb, 8, 8, 8)), jnp.float32)
+    v = jnp.asarray(
+        np.random.default_rng(1).standard_normal((nb, 8, 8, 8, 3)),
+        jnp.float32)
+
+    lap = jax.jit(lambda f, t, ft: amr_ops.laplacian_blocks(g, f, t, ft))
+    t_lap = timeit(lap, x, tab, ftab)
+    print(f"laplacian_blocks[faces]: {t_lap*1e3:8.3f} ms "
+          f"({nb*512/t_lap/1e6:.1f} Mcell/s)")
+
+    asm = jax.jit(lambda f, t: t.assemble_scalar(f, g.bs))
+    print(f"assemble_scalar[faces]:  {timeit(asm, x, tab)*1e3:8.3f} ms")
+
+    h2 = jnp.asarray((g.h**2).reshape(nb, 1, 1, 1), jnp.float32)
+
+    def M(r):
+        return krylov.block_cg_tiles(-h2 * r, 24)
+
+    def k_iters(b, t, ft, k):
+        A = lambda v_: amr_ops.laplacian_blocks(g, v_, t, ft)
+        return krylov.bicgstab(A, b, M=M, tol_abs=0.0, tol_rel=0.0, maxiter=k)
+    f5 = jax.jit(lambda b, t, ft: k_iters(b, t, ft, 5))
+    f10 = jax.jit(lambda b, t, ft: k_iters(b, t, ft, 10))
+    t5 = timeit(f5, x, tab, ftab, n=10, warmup=4)
+    t10 = timeit(f10, x, tab, ftab, n=10, warmup=4)
+    per_it = (t10 - t5) / 5
+    print(f"bicgstab per-iter[faces]: {per_it*1e3:8.3f} ms "
+          f"({nb*512/per_it/1e6:.1f} Mcell/s-iter)")
+
+    rk = jax.jit(lambda vv, t, ft: amr_ops.rk3_step_blocks(
+        g, vv, 1e-3, 1e-3, jnp.zeros(3, jnp.float32), t, ft))
+    t_rk_old = timeit(rk, v, sim._tab3, ftab, n=6, warmup=3)
+    print(f"rk3_step[old w=3]:       {t_rk_old*1e3:8.3f} ms")
+    t_rk = timeit(rk, v, tab3, ftab, n=6, warmup=3)
+    print(f"rk3_step[faces w=3]:     {t_rk*1e3:8.3f} ms")
+
+
+if __name__ == "__main__":
+    face_path() if os.environ.get("PROF_FACES") else main()
