@@ -79,6 +79,24 @@ def _time_steps(advance, calc_dt, warmup: int, iters: int,
         return (time.perf_counter() - t0) / iters
 
 
+def _time_steps_robust(advance, calc_dt, warmup: int, iters: int,
+                       tag: str = "run"):
+    """Per-step walls -> (median, mean, max).  The tunneled TPU's
+    device->host reads sporadically stall 1-3 s regardless of cadence or
+    strategy (measured; pure transport noise) — the median is the
+    defensible per-step cost, the mean/max quantify the stall exposure."""
+    for _ in range(warmup):
+        advance(calc_dt())
+    walls = []
+    with _maybe_trace(tag):
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            advance(calc_dt())
+            walls.append(time.perf_counter() - t0)
+    w = np.asarray(walls)
+    return float(np.median(w)), float(w.mean()), float(w.max())
+
+
 def bench_fish_uniform(n_default: int = 128):
     """BASELINE config #2: uniform self-propelled fish, iterative Poisson
     at 1e-6/1e-4 (CUP3D_BENCH_CONFIG=fish256 runs it at 256^3, the closest
@@ -107,13 +125,16 @@ def bench_fish_uniform(n_default: int = 128):
     )
     sim = Simulation(cfg)
     sim.init()
-    iters = 8
-    for _ in range(3):  # warmup (compiles) outside the profiled window
+    iters = 16
+    for _ in range(10):  # warmup: compiles + two grouped-read cycles
         sim.advance(sim.calc_max_timestep())
     sim.sim.profiler.totals.clear()
     sim.sim.profiler.counts.clear()
-    wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=0,
-                       iters=iters, tag="fish")
+    wall, wall_mean, wall_max = _time_steps_robust(
+        sim.advance, sim.calc_max_timestep, warmup=0, iters=iters,
+        tag="fish",
+    )
+    sim.flush_packs()
     cells_s = n**3 / wall
 
     from cup3d_tpu.ops import diagnostics as diag
@@ -184,6 +205,8 @@ def bench_fish_uniform(n_default: int = 128):
     return {
         "cells_per_s": cells_s,
         "wall_per_step_s": round(wall, 4),
+        "wall_per_step_mean_s": round(wall_mean, 4),
+        "wall_per_step_max_s": round(wall_max, 4),
         "div_max": float(div_max),
         "div_max_fluid": float(div_fluid),
         "bicgstab_iters_to_tol": int(k_cold),
@@ -335,13 +358,17 @@ def bench_amr_tgv():
     # mesh so the timed window has no re-layouts/recompiles
     sim.adapt_enabled = False
     iters = 10
-    wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=3,
-                       iters=iters, tag="amr_tgv")
+    med, mean, wmax = _time_steps_robust(
+        sim.advance, sim.calc_max_timestep, warmup=3, iters=iters,
+        tag="amr_tgv",
+    )
     total, div_max = sim._divnorms(sim.state["vel"])
     nb = sim.grid.nb
     return {
-        "wall_per_step_s": round(wall, 4),
-        "cells_per_s": nb * sim.grid.bs**3 / wall,
+        "wall_per_step_s": round(med, 4),
+        "wall_per_step_mean_s": round(mean, 4),
+        "wall_per_step_max_s": round(wmax, 4),
+        "cells_per_s": nb * sim.grid.bs**3 / med,
         "blocks": int(nb),
         "levels": sorted(set(int(l) for l in np.asarray(sim.grid.level))),
         "div_max": float(div_max),
@@ -380,19 +407,32 @@ def bench_two_fish_amr():
     sim = AMRSimulation(cfg)
     sim.init()
     # the first 10 steps adapt EVERY step (reference main.cpp:15314); time
-    # the steady state, where adaptation amortizes 1-in-20 (the window
-    # below covers exactly one adaptation at step 20)
-    iters = 12
-    wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=11,
-                       iters=iters, tag="two_fish_amr")
+    # the steady state, where adaptation amortizes 1-in-20.  Warmup must
+    # cross TWO batched-read groups and one adaptation so every one-time
+    # compile (group concat, scores prefetch, megastep) happens outside
+    # the timed window; the window then covers exactly one adaptation.
+    iters = 20
+    med, mean, wmax = _time_steps_robust(
+        sim.advance, sim.calc_max_timestep, warmup=25, iters=iters,
+        tag="two_fish_amr",
+    )
+    sim.flush_packs()
     total, div_max = sim._divnorms(sim.state["vel"])
+    from cup3d_tpu.ops.diagnostics import fluid_divergence_max_blocks
+
+    div_fluid = fluid_divergence_max_blocks(
+        sim.grid, sim.state["vel"], sim.state["chi"], sim._tab1
+    )
     nb = sim.grid.nb
     return {
-        "wall_per_step_s": round(wall, 4),
-        "cells_per_s": nb * sim.grid.bs**3 / wall,
+        "wall_per_step_s": round(med, 4),
+        "wall_per_step_mean_s": round(mean, 4),
+        "wall_per_step_max_s": round(wmax, 4),
+        "cells_per_s": nb * sim.grid.bs**3 / med,
         "blocks": int(nb),
         "levels": level_max,
         "div_max": float(div_max),
+        "div_max_fluid": float(div_fluid),
     }
 
 

@@ -80,6 +80,21 @@ def fluid_divergence_max(grid: UniformGrid, u: jnp.ndarray,
     return jnp.max(jnp.abs(jnp.where(grow, 0.0, d)))
 
 
+def fluid_divergence_max_blocks(grid, vel, chi, tab):
+    """Block-forest twin of fluid_divergence_max: max |div u| over blocks
+    whose chi halo'd lab vanishes everywhere — block granularity plus the
+    ghost halo gives at least a stencil-width separation from the band."""
+    from cup3d_tpu.ops import amr_ops
+
+    vlab = tab.assemble_vector(vel, grid.bs)
+    d = amr_ops.div_blocks(grid, vlab, tab.width)
+    clab = tab.assemble_scalar(chi, grid.bs)
+    fluid = jnp.max(clab.reshape(grid.nb, -1), axis=1) < 1e-6
+    return jnp.max(
+        jnp.where(fluid[:, None, None, None], jnp.abs(d), 0.0)
+    )
+
+
 def max_velocity(u: jnp.ndarray, uinf: jnp.ndarray) -> jnp.ndarray:
     """max over cells of max-norm of lab-frame velocity (findMaxU)."""
     return jnp.max(jnp.abs(u + uinf))

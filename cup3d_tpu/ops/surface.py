@@ -1,0 +1,394 @@
+"""Surface-point force probing: the reference's KernelComputeForces
+(main.cpp:12250-12494) + surface extraction (main.cpp:13291-13404) as a
+dense TPU kernel.
+
+The reference walks per-block ragged surface-point lists; each point
+probes the velocity field up to 4 cells OUTSIDE the body along the
+outward normal with one-sided 5th-order stencils and Taylor-corrects the
+gradient back to the surface cell.  That machinery is what makes its drag
+measure converge — the dense chi-band substitute under-reads pressure
+inside the penalized band by a flat ~28% on the sphere (VALIDATION.md,
+VERDICT r2 missing #1).
+
+TPU formulation: obstacle surfaces live on finest-level blocks (grad-chi
+tagging forces max refinement), so the band's neighborhood is locally
+UNIFORM at hmin.  The driver gathers the obstacle's holding blocks into a
+dense local window (block-granular gathers); every step of the reference
+algorithm is then a static-shape dense computation over the window:
+
+- surface measure: delta = (grad H . grad phi)/|grad phi|^2 per cell
+  (Towers; reference Delta with the h factors made physical), surface
+  cells = cells with delta > 0; outward normal n = -grad phi/|grad phi|
+  (phi > 0 inside);
+- probe point: first cell along round(k*n), k = 0..4, with chi < 0.01
+  (else the last in-window candidate) — reference marching loop;
+- velocity gradient at the probe point: 6-point one-sided 5th-order
+  per axis in the sign(n) direction, falling back to 3-point/2-point
+  when the window (reference: the lab) runs out; second + mixed
+  derivatives Taylor-correct the gradient back to the surface cell;
+- tractions: f = -P(surface cell) n dS + (nu/h) (grad_u . n dS) with
+  UNDIVIDED derivatives (the reference's bookkeeping), and the same
+  reductions: force/torque split, thrust/drag along velUnit, Pout,
+  defPower, pLocom.
+
+Everything is masked dense math + in-window gathers; no ragged lists.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-21
+_C6 = (-137.0 / 60.0, 5.0, -5.0, 10.0 / 3.0, -5.0 / 4.0, 1.0 / 5.0)
+
+
+def _shift(f, ox, oy, oz):
+    """Zero-padded static shift: out[i] = f[i + o]."""
+    pad = [(max(-ox, 0), max(ox, 0)), (max(-oy, 0), max(oy, 0)),
+           (max(-oz, 0), max(oz, 0))] + [(0, 0)] * (f.ndim - 3)
+    g = jnp.pad(f, pad)
+    sl = tuple(
+        slice(p[0] + o, p[0] + o + n)
+        for p, o, n in zip(pad[:3], (ox, oy, oz), f.shape[:3])
+    ) + (slice(None),) * (f.ndim - 3)
+    return g[sl]
+
+
+def _central(f, axis):
+    """Undivided centered difference along axis (zero-padded edges)."""
+    o = [0, 0, 0]
+    o[axis] = 1
+    hi = _shift(f, *o)
+    o[axis] = -1
+    lo = _shift(f, *o)
+    return 0.5 * (hi - lo)
+
+
+def _flat_index(ix, iy, iz, shape):
+    return (ix * shape[1] + iy) * shape[2] + iz
+
+
+def _gather(fflat, ix, iy, iz, shape):
+    """Window gather with clamped indices (callers mask validity)."""
+    ix = jnp.clip(ix, 0, shape[0] - 1)
+    iy = jnp.clip(iy, 0, shape[1] - 1)
+    iz = jnp.clip(iz, 0, shape[2] - 1)
+    return fflat[_flat_index(ix, iy, iz, shape)]
+
+
+def surface_force_window(
+    vel: jnp.ndarray,  # (Wx, Wy, Wz, 3) window velocity
+    p: jnp.ndarray,  # (Wx, Wy, Wz)
+    chi: jnp.ndarray,
+    sdf: jnp.ndarray,  # phi > 0 inside
+    udef: jnp.ndarray,  # (Wx, Wy, Wz, 3)
+    valid: jnp.ndarray,  # (Wx, Wy, Wz) bool: cell carries real field data
+    xc: jnp.ndarray,  # (Wx, Wy, Wz, 3) physical cell centers
+    h,  # window spacing (finest level)
+    nu: float,
+    cm: jnp.ndarray,  # (3,)
+    u_trans: jnp.ndarray,  # (3,)
+    omega: jnp.ndarray,  # (3,)
+) -> Dict[str, jnp.ndarray]:
+    """Reference KernelComputeForces on a dense uniform window.  Returns
+    the force-integral dict of models.base.force_integrals (pres/visc
+    force, torque, power, thrust/drag/def_power) measured at probed
+    surface points."""
+    shape = vel.shape[:3]
+    dtype = vel.dtype
+
+    # -- surface measure + outward normal (KernelCharacteristicFunction) --
+    gphi = jnp.stack([_central(sdf, a) for a in range(3)], -1)  # undivided*h
+    gH = jnp.stack([_central(chi, a) for a in range(3)], -1)
+    gphi2 = jnp.sum(gphi * gphi, -1) + _EPS
+    # (gH.gphi)/|gphi|^2 with BOTH gradients undivided equals the physical
+    # Towers surface density delta(x) [1/length]; dS = delta * h^3
+    # (reference Delta = fac1*numD/gradUSq with its 2h/inv2h bookkeeping)
+    dS = jnp.sum(gH * gphi, -1) / gphi2 * (h * h * h)
+    nhat = -gphi / jnp.sqrt(gphi2)[..., None]  # outward unit normal
+    surf = (dS > 1e-12) & valid
+    dS = jnp.where(surf, dS, 0.0)
+
+    ii = jnp.arange(shape[0])[:, None, None]
+    jj = jnp.arange(shape[1])[None, :, None]
+    kk = jnp.arange(shape[2])[None, None, :]
+    base = (jnp.broadcast_to(ii, shape), jnp.broadcast_to(jj, shape),
+            jnp.broadcast_to(kk, shape))
+    chif = chi.reshape(-1)
+    validf = valid.reshape(-1)
+
+    def inwin(ix, iy, iz):
+        geo = (
+            (ix >= 0) & (ix < shape[0]) & (iy >= 0) & (iy < shape[1])
+            & (iz >= 0) & (iz < shape[2])
+        )
+        return geo & _gather(validf, ix, iy, iz, shape)
+
+    # -- probe point: march outward to the first chi < 0.01 cell ----------
+    px, py, pz = base
+    found = jnp.zeros(shape, bool)
+    for k in range(5):
+        cx = base[0] + jnp.round(k * nhat[..., 0]).astype(jnp.int32)
+        cy = base[1] + jnp.round(k * nhat[..., 1]).astype(jnp.int32)
+        cz = base[2] + jnp.round(k * nhat[..., 2]).astype(jnp.int32)
+        ok = inwin(cx, cy, cz) & ~found
+        px = jnp.where(ok, cx, px)
+        py = jnp.where(ok, cy, py)
+        pz = jnp.where(ok, cz, pz)
+        found = found | (ok & (_gather(chif, cx, cy, cz, shape) < 0.01))
+
+    sx = jnp.where(nhat[..., 0] > 0, 1, -1).astype(jnp.int32)
+    sy = jnp.where(nhat[..., 1] > 0, 1, -1).astype(jnp.int32)
+    sz = jnp.where(nhat[..., 2] > 0, 1, -1).astype(jnp.int32)
+
+    velf = vel.reshape(-1, 3)
+
+    def vat(ix, iy, iz):
+        return _gather(velf, ix, iy, iz, shape)
+
+    def axis_pts(axis, s):
+        """Probe-relative sample positions k*s along one axis."""
+        def at(k):
+            o = [px, py, pz]
+            o[axis] = o[axis] + k * s
+            return o
+        return at
+
+    def one_sided(axis, s):
+        """Undivided one-sided first derivative at the probe point:
+        6-pt 5th order -> 3-pt 2nd order -> 2-pt 1st order, by range
+        (reference inrange cascade)."""
+        at = axis_pts(axis, s)
+        v = [vat(*at(k)) for k in range(6)]
+        d6 = s[..., None] * sum(c * vk for c, vk in zip(_C6, v))
+        d3 = s[..., None] * (-1.5 * v[0] + 2.0 * v[1] - 0.5 * v[2])
+        d2 = s[..., None] * (v[1] - v[0])
+        ok5 = inwin(*at(5))[..., None]
+        ok2 = inwin(*at(2))[..., None]
+        return jnp.where(ok5, d6, jnp.where(ok2, d3, d2))
+
+    dvdx = one_sided(0, sx)
+    dvdy = one_sided(1, sy)
+    dvdz = one_sided(2, sz)
+
+    def second(axis):
+        o = [px, py, pz]
+        o2 = [px, py, pz]
+        o = list(o)
+        o[axis] = o[axis] + 1
+        o2[axis] = o2[axis] - 1
+        return vat(*o) - 2.0 * vat(px, py, pz) + vat(*o2)
+
+    d2x, d2y, d2z = second(0), second(1), second(2)
+
+    def mixed(a1, s1, a2, s2):
+        """Nested one-sided mixed derivative (reference dveldxdy form),
+        falling back to the compact 2x2 form when out of range."""
+        def at(k1, k2):
+            o = [px, py, pz]
+            o[a1] = o[a1] + k1 * s1
+            o[a2] = o[a2] + k2 * s2
+            return o
+
+        def row(k1):  # 3-pt one-sided along a2 at offset k1 along a1
+            return (-1.5 * vat(*at(k1, 0)) + 2.0 * vat(*at(k1, 1))
+                    - 0.5 * vat(*at(k1, 2)))
+
+        full = (s1 * s2)[..., None] * (
+            -0.5 * row(2) + 2.0 * row(1) - 1.5 * row(0)
+        )
+        compact = (s1 * s2)[..., None] * (
+            vat(*at(1, 1)) - vat(*at(1, 0))
+        ) - (vat(*at(0, 1)) - vat(*at(0, 0)))
+        ok = (inwin(*at(2, 0)) & inwin(*at(0, 2)))[..., None]
+        return jnp.where(ok, full, compact)
+
+    dxy = mixed(0, sx, 1, sy)
+    dxz = mixed(0, sx, 2, sz)
+    dyz = mixed(1, sy, 2, sz)
+
+    # Taylor-correct the gradient from the probe point back to the
+    # surface cell (integer offsets; undivided derivatives throughout)
+    ox = (base[0] - px)[..., None].astype(dtype)
+    oy = (base[1] - py)[..., None].astype(dtype)
+    oz = (base[2] - pz)[..., None].astype(dtype)
+    gx = dvdx + d2x * ox + dxy * oy + dxz * oz  # (..., 3): du/dx, dv/dx, dw/dx
+    gy = dvdy + d2y * oy + dyz * oz + dxy * ox
+    gz = dvdz + d2z * oz + dxz * ox + dyz * oy
+
+    # -- tractions ---------------------------------------------------------
+    n_meas = nhat * dS[..., None]  # outward normal * dS
+    P = p
+    inv_h = nu / h
+    fV = inv_h * (
+        gx * n_meas[..., 0:1] + gy * n_meas[..., 1:2] + gz * n_meas[..., 2:3]
+    )
+    fP = -P[..., None] * n_meas
+    fT = fV + fP
+
+    vel_norm = jnp.linalg.norm(u_trans)
+    vel_unit = jnp.where(vel_norm > 1e-9, u_trans / jnp.where(
+        vel_norm > 0, vel_norm, 1.0), 0.0)
+
+    r = xc - cm
+    pres_force = jnp.sum(fP, axis=(0, 1, 2))
+    visc_force = jnp.sum(fV, axis=(0, 1, 2))
+    torque = jnp.sum(jnp.cross(r, fT), axis=(0, 1, 2))
+    force_par = jnp.sum(fT * vel_unit, -1)
+    thrust = jnp.sum(0.5 * (force_par + jnp.abs(force_par)))
+    drag = -jnp.sum(0.5 * (force_par - jnp.abs(force_par)))
+    pow_out = jnp.sum(fT * vel)
+    def_power = jnp.sum(fT * udef)
+    return {
+        "pres_force": pres_force,
+        "visc_force": visc_force,
+        "torque": torque,
+        "power": pow_out,
+        "thrust": thrust,
+        "drag": drag,
+        "def_power": def_power,
+    }
+
+
+# ---------------------------------------------------------------------------
+# window extraction: dense local neighborhoods around one obstacle
+# ---------------------------------------------------------------------------
+
+
+def window_size_cells(length: float, h: float, bs: int = 8) -> int:
+    """Static window edge (cells): the rasterizer's AABB margin
+    (0.625 L + 8h), rounded up to whole blocks so AMR gathers stay
+    block-granular and jit retraces only on bucket changes."""
+    half = 0.625 * length + 8.0 * h
+    return int(-(-2.0 * half / h // bs) * bs)
+
+
+@partial(jax.jit, static_argnames=("wcells",))
+def _uniform_window_probe(vel, p, chi, sdf, udef, idx0, h, origin0, nu,
+                          cm, u_trans, omega, wcells):
+    sl3 = (wcells,) * 3
+    wv = jax.lax.dynamic_slice(vel, (idx0[0], idx0[1], idx0[2], 0),
+                               sl3 + (3,))
+    wu = jax.lax.dynamic_slice(udef, (idx0[0], idx0[1], idx0[2], 0),
+                               sl3 + (3,))
+    wp = jax.lax.dynamic_slice(p, tuple(idx0), sl3)
+    wc = jax.lax.dynamic_slice(chi, tuple(idx0), sl3)
+    ws = jax.lax.dynamic_slice(sdf, tuple(idx0), sl3)
+    loc = jnp.stack(
+        jnp.meshgrid(*[jnp.arange(wcells, dtype=vel.dtype) + 0.5] * 3,
+                     indexing="ij"),
+        axis=-1,
+    )
+    xc = origin0 + (idx0.astype(vel.dtype) + loc) * h
+    valid = jnp.ones(sl3, bool)
+    return surface_force_window(
+        wv, wp, wc, ws, wu, valid, xc, h, nu, cm, u_trans, omega
+    )
+
+
+def force_integrals_probe_uniform(grid, ob, vel, p, chi, sdf, udef, nu,
+                                  cm, u_trans, omega):
+    """Uniform-grid driver entry: AABB window around the obstacle."""
+    n = np.asarray(grid.shape)
+    w = window_size_cells(ob.length, grid.h)
+    w = int(min(w, n.min()))
+    half = 0.5 * w * grid.h
+    pos = np.asarray(ob.position)
+    idx0 = np.clip(
+        np.floor((pos - half) / grid.h).astype(np.int64), 0, n - w
+    )
+    return _uniform_window_probe(
+        vel, p, chi, sdf, udef, jnp.asarray(idx0, jnp.int32),
+        jnp.asarray(grid.h, vel.dtype), jnp.zeros(3, vel.dtype), nu,
+        jnp.asarray(cm, vel.dtype), jnp.asarray(u_trans, vel.dtype),
+        jnp.asarray(omega, vel.dtype), wcells=w,
+    )
+
+
+def block_window_slots(grid, position: np.ndarray, length: float):
+    """Host: finest-level block slots covering the obstacle AABB.
+    Returns (slots (nbx,nby,nbz) int32 with -1 for positions not owned at
+    the finest level, window block origin (3,) ints, h_fine)."""
+    lmax = len(grid._slot_maps) - 1
+    h = grid.h0 / (1 << lmax)
+    bs = grid.bs
+    nbd = np.asarray(grid.tree.blocks_per_dim(lmax))
+    half = 0.625 * length + 8.0 * h
+    b0 = np.floor((position - half) / (bs * h)).astype(np.int64)
+    b1 = np.ceil((position + half) / (bs * h)).astype(np.int64)
+    b0 = np.clip(b0, 0, nbd - 1)
+    b1 = np.clip(b1, 1, nbd)
+    rng = [np.arange(b0[a], b1[a]) for a in range(3)]
+    slots = grid._slot_maps[lmax][np.ix_(*rng)].astype(np.int32)
+    return slots, b0, h
+
+
+@jax.jit
+def _gather_block_window(field, slots):
+    """(nb, bs, bs, bs[,C]) + (nbx,nby,nbz) slots -> dense window; rows
+    with slot -1 fill with zeros."""
+    nbx, nby, nbz = slots.shape
+    bs = field.shape[1]
+    flat = jnp.take(field, slots.reshape(-1), axis=0, mode="fill",
+                    fill_value=0)
+    trail = field.shape[4:]
+    wi = flat.reshape((nbx, nby, nbz, bs, bs, bs) + trail)
+    wi = jnp.moveaxis(wi, 3, 1)  # (nbx, bs, nby, nbz, bs, bs, ...)
+    wi = jnp.moveaxis(wi, 4, 3)
+    return wi.reshape((nbx * bs, nby * bs, nbz * bs) + trail)
+
+
+def probe_blocks_core(vel, p, ob_chi, ob_sdf, ob_udef, slots, b0, h, nu,
+                      cm, u_trans, omega):
+    """Traceable AMR probe core: gather the finest-level holding blocks
+    into a dense window (block-granular takes) and run the surface probe.
+    ``slots``: (nbx,nby,nbz) int32 block slots, -1 where the position is
+    not owned at the finest level — those window cells are invalid and
+    probes fall back to shorter stencils there, mirroring the reference's
+    lab-range cascade.  ``b0``: (3,) window origin in finest-block units.
+    Callable inside jit (the pipelined megastep) or via the jitted
+    wrapper below."""
+    wv = _gather_block_window(vel, slots)
+    wp = _gather_block_window(p, slots)
+    wc = _gather_block_window(ob_chi, slots)
+    ws = _gather_block_window(ob_sdf, slots)
+    wu = _gather_block_window(ob_udef, slots)
+    bs = vel.shape[1]
+    valid = jnp.repeat(
+        jnp.repeat(jnp.repeat(slots >= 0, bs, 0), bs, 1), bs, 2
+    )
+    shape = wv.shape[:3]
+    dtype = wv.dtype
+    loc = jnp.stack(
+        jnp.meshgrid(*[jnp.arange(s, dtype=dtype) + 0.5 for s in shape],
+                     indexing="ij"),
+        axis=-1,
+    )
+    xc = (b0.astype(dtype) * bs + loc) * h
+    return surface_force_window(
+        wv, wp, wc, ws, wu, valid, xc, h, nu, cm, u_trans, omega
+    )
+
+
+_probe_blocks_jit = jax.jit(probe_blocks_core, static_argnames=("nu",))
+
+
+def force_integrals_probe_blocks(grid, state_fields, ob_chi, ob_sdf,
+                                 ob_udef, nu, position, length, cm,
+                                 u_trans, omega):
+    """Host-calling AMR entry: host computes the window slots, the jitted
+    core does the rest."""
+    slots, b0, h = block_window_slots(grid, np.asarray(position), length)
+    vel, p = state_fields["vel"], state_fields["p"]
+    dtype = vel.dtype
+    return _probe_blocks_jit(
+        vel, p, ob_chi, ob_sdf, ob_udef, jnp.asarray(slots),
+        jnp.asarray(b0, jnp.int32), jnp.asarray(h, dtype), float(nu),
+        jnp.asarray(cm, dtype), jnp.asarray(u_trans, dtype),
+        jnp.asarray(omega, dtype),
+    )

@@ -58,6 +58,21 @@ ADAPT_EVERY = 20  # reference cadence (main.cpp:15314)
 _EPS = 1e-6
 
 
+@partial(jax.jit, static_argnames=("combine",))
+def _combine_obstacle_fields(sdfs, udefs, h_raw, combine=True):
+    """(n_obs, nb, ...) sdf/udef stacks -> per-obstacle chi/masked-udef +
+    (optionally) the chi-weighted combined fields, in one dispatch.  The
+    pipelined megastep recombines on device, so it passes combine=False."""
+    chis = heaviside(sdfs, h_raw[None])
+    udefs = udefs * (chis > 0)[..., None]
+    if not combine:
+        return chis, udefs, None, None
+    chi = jnp.max(chis, axis=0)
+    den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
+    udef = jnp.sum(chis[..., None] * udefs, axis=0) / den
+    return chis, udefs, chi, udef
+
+
 class AMRSimulation:
     """Adaptive driver.  With ``mesh`` (a 1-D jax Mesh) every block-axis
     field lives padded + sharded over the devices and all halo exchange /
@@ -107,10 +122,14 @@ class AMRSimulation:
         # (the uniform driver's depth-2 scheme, sim/simulation.py), plus a
         # collision fallback latch that reroutes to the host path while any
         # stale overlap pre-check is non-zero
-        self._pack_queue: List[dict] = []
-        self._reader = None
+        from cup3d_tpu.sim.pack import GroupedPackReader
+
+        self._pack_reader = GroupedPackReader(self._consume_entry)
         self._uinf_dev = None
         self._collision_hot = False
+        # refinement scores dispatched one step EARLY in pipelined mode so
+        # the device compute + transfer overlap the inter-step host work
+        self._scores_prefetch = None
         self._rebuild()
         self._alloc_fields()
 
@@ -381,15 +400,15 @@ class AMRSimulation:
         cfg = self.cfg
         g = self.grid
         nu = self.nu
-        xc = self._xc
-        vol = self._vol
         rigid_vmapped = jax.vmap(
             rigid_update_device, in_axes=(0, 0, 0, 0, None, None)
         )
         if cfg.bFixMassFlux:
             vol_total = float(np.sum(g.h**3) * g.bs**3)
-            eta = jnp.asarray((xc[..., 1] / g.extent[1]), self.dtype)
-            profile = 6.0 * eta * (1.0 - eta)
+            eta = jnp.asarray((self._xc[..., 1] / g.extent[1]), self.dtype)
+            profile_arr = 6.0 * eta * (1.0 - eta)
+        else:
+            profile_arr = jnp.zeros((), self.dtype)  # unused placeholder
         helm = None
         if cfg.implicitDiffusion:
             from cup3d_tpu.ops import diffusion as dif
@@ -403,7 +422,8 @@ class AMRSimulation:
             )
 
         def mega(vel, p, chis, udefs, rigid, forced, blocked, fixmask,
-                 uinf, dt, lam, tab1, tab3, ftab, second_order):
+                 uinf, dt, lam, tab1, tab3, ftab, xc, vol, profile,
+                 second_order):
             n_obs = chis.shape[0]
             chi = jnp.max(chis, axis=0)
             den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
@@ -508,13 +528,15 @@ class AMRSimulation:
             )
             return vel, p, chi, udef, uinf_next, pack
 
-        # tables travel as jit ARGUMENTS (pytrees), not closure constants —
-        # the compile-payload rule of _rebuild applies here too
+        # tables AND field-sized geometry (cell centers, volumes, forcing
+        # profile) travel as jit ARGUMENTS, not closure constants — the
+        # compile-payload rule of _rebuild applies here too
         j1 = jax.jit(partial(mega, second_order=False))
         j2 = jax.jit(partial(mega, second_order=True))
         self._megastep = lambda *a: (
             j2 if self.step_idx >= self.cfg.step_2nd_start else j1
-        )(*a, self._tab1, self._tab3, self._ftab)
+        )(*a, self._tab1, self._tab3, self._ftab, self._xc, self._vol,
+          profile_arr)
 
     # -- obstacles ---------------------------------------------------------
 
@@ -526,34 +548,38 @@ class AMRSimulation:
 
         self.obstacles = make_obstacles(self, parse_factory(content))
 
-    def create_obstacles(self, dt: float = 0.0):
-        """Reference CreateObstacles (main.cpp:13589-13621) on blocks."""
+    def create_obstacles(self, dt: float = 0.0, combine: bool = True):
+        """Reference CreateObstacles (main.cpp:13589-13621) on blocks.
+        Heaviside + masking + the chi-weighted combine run as ONE jitted
+        dispatch over all obstacles (eagerly they cost ~10 tunnel round
+        trips per step).  advance_pipelined passes combine=False: the
+        megastep recombines on device, so the combined-state write here
+        would be dead work (every other caller needs it)."""
         if not self.obstacles:
             return
         fixed = [ob for ob in self.obstacles if ob.bFixFrameOfRef]
         if fixed:
             self.uinf = -np.mean([ob.transVel for ob in fixed], axis=0)
-        chis, udefs = [], []
         h_raw = jnp.asarray(
             self.grid.h.reshape(self.grid.nb, 1, 1, 1), self.dtype
         )
+        sdfs, udefs = [], []
         for ob in self.obstacles:
             ob.update_shape(self.time, dt)
             sdf, udef = ob.rasterize(self.time)  # unpadded (nb, ...)
-            chi = heaviside(sdf, h_raw)
-            udef = (
-                udef * (chi > 0)[..., None]
-                if udef is not None
-                else self.grid.zeros(3, self.dtype)
+            sdfs.append(sdf)
+            udefs.append(
+                udef if udef is not None else self.grid.zeros(3, self.dtype)
             )
-            ob.chi = self._pad(chi)
-            ob.udef = self._pad(udef)
-            chis.append(ob.chi)
-            udefs.append(ob.udef)
-        stack = jnp.stack(chis)
-        self.state["chi"] = jnp.max(stack, axis=0)
-        den = jnp.maximum(jnp.sum(stack, axis=0), _EPS)[..., None]
-        self.state["udef"] = sum(c[..., None] * u for c, u in zip(chis, udefs)) / den
+        chis, udefs, chi, udef = _combine_obstacle_fields(
+            jnp.stack(sdfs), jnp.stack(udefs), h_raw, combine
+        )
+        for i, ob in enumerate(self.obstacles):
+            ob.chi = self._pad(chis[i])
+            ob.udef = self._pad(udefs[i])
+        if combine:
+            self.state["chi"] = self._pad(chi)
+            self.state["udef"] = self._pad(udef)
 
     def _obstacle_ubody(self, ob):
         # cached per (step, rigid state); penalization and the force pass
@@ -585,7 +611,22 @@ class AMRSimulation:
     def adapt_mesh(self):
         g = self.grid
         cfg = self.cfg
-        vort, near_body = self._scores(self.state["vel"], self.state["chi"])
+        if self._scores_prefetch is not None:
+            packed, nb_at = self._scores_prefetch
+            self._scores_prefetch = None
+            if nb_at != g.nb:  # layout changed since dispatch: recompute
+                packed = None
+        else:
+            packed = None
+        if packed is None:
+            vort, near_body = self._scores(
+                self.state["vel"], self.state["chi"]
+            )
+        else:
+            vals = np.asarray(packed, np.float64)
+            vort, near_body = vals[: vals.shape[0] // 2], (
+                vals[vals.shape[0] // 2:] > 0.5
+            )
         score = np.asarray(vort, np.float64)[: g.nb]
         near = np.asarray(near_body)[: g.nb]
         if cfg.bAdaptChiGradient and near.any():
@@ -735,7 +776,7 @@ class AMRSimulation:
             and not self._collision_hot
         ):
             return self.advance_pipelined(dt)
-        if self._pack_queue or self._reader is not None:
+        if self._pack_reader:
             # entering the host path from pipelined mode (collision
             # fallback or mode switch): mirrors must be current and the
             # device chains dropped
@@ -891,15 +932,14 @@ class AMRSimulation:
             self.step_idx < 10 or self.step_idx % ADAPT_EVERY == 0
         ):
             with self.profiler("AdaptMesh"):
-                self.flush_packs()
-                # restart the device chains from the refreshed mirrors:
-                # the re-laid-out fields get new jitted steps anyway
-                for ob in self.obstacles:
-                    ob._dev_rigid = None
-                self._uinf_dev = None
+                # no flush: packs are immutable device vectors (still
+                # readable after re-layout) and the rigid chains are pure
+                # kinematic state, independent of the field layout; the
+                # no-change case (the steady-state common one) costs only
+                # the prefetched scores read
                 self.adapt_mesh()
         with self.profiler("CreateObstacles"):
-            self.create_obstacles(dt)
+            self.create_obstacles(dt, combine=False)
         with self.profiler("Megastep"):
             n = len(self.obstacles)
             chis = jnp.stack([ob.chi for ob in self.obstacles])
@@ -936,6 +976,21 @@ class AMRSimulation:
                     "ang": row[3:6], "cm": row[12:15],
                 }
                 ob._ubody_cache = None
+            nxt = self.step_idx + 1
+            if self.adapt_enabled and (
+                nxt < 10 or nxt % ADAPT_EVERY == 0
+            ):
+                # dispatch next step's refinement scores now: the compute
+                # and transfer overlap this step's pack read + host work
+                vort, near = self._scores(s["vel"], s["chi"])
+                packed = jnp.concatenate(
+                    [vort.astype(self.dtype), near.astype(self.dtype)]
+                )
+                try:
+                    packed.copy_to_host_async()
+                except Exception:
+                    pass
+                self._scores_prefetch = (packed, self.grid.nb)
         freq = self.cfg.freqDiagnostics
         if freq > 0 and self.step_idx % freq == 0:
             # same div.txt/energy.txt rows as the host path; the blocking
@@ -959,48 +1014,20 @@ class AMRSimulation:
             layout = [("rigid", n * RIGID_PACK), ("penal", n * 6),
                       ("forces", n * 13), ("overlap", npairs), ("flux", 1),
                       ("umax", 1)]
-            try:
-                pack.copy_to_host_async()
-            except Exception:
-                pass
-            self._pack_queue.append(
+            # grouped deferred read (sim/pack.py): K packs -> one device
+            # concat -> one worker-thread fetch, amortizing the tunnel's
+            # per-read latency; staleness bounded by ~2K steps
+            self._pack_reader.emit(
                 {"layout": layout, "pack": pack, "time": self.time,
                  "step": self.step_idx}
             )
-            self._join_reader()
-            if len(self._pack_queue) >= 2:
-                import threading
-
-                entry = self._pack_queue.pop(0)
-                th = threading.Thread(target=self._fetch_entry, args=(entry,))
-                th.start()
-                self._reader = (th, entry)
         self.step_idx += 1
         self.time += dt
 
-    @staticmethod
-    def _fetch_entry(entry: dict) -> None:
-        try:
-            entry["vals"] = np.asarray(entry["pack"], np.float64)
-        except BaseException as e:  # re-raised on the main thread at join
-            entry["err"] = e
-
-    def _join_reader(self):
-        if self._reader is None:
-            return
-        th, entry = self._reader
-        self._reader = None
-        th.join()
-        if "err" in entry:
-            raise entry["err"]
-        self._consume_entry(entry)
-
     def flush_packs(self):
-        """Drain pending packs so host mirrors are current (dump/
-        checkpoint/adaptation/fallback boundaries)."""
-        self._join_reader()
-        while self._pack_queue:
-            self._consume_entry(self._pack_queue.pop(0))
+        """Drain in-flight reads + pending packs so host mirrors are
+        current (dump/checkpoint/fallback boundaries)."""
+        self._pack_reader.flush()
 
     def _consume_entry(self, entry: dict):
         vals = entry.get("vals")

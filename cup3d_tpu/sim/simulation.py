@@ -29,12 +29,13 @@ class Simulation:
         # max|u| fetched in the previous step's packed read (fast path):
         # saves the blocking read at the top of calc_max_timestep
         self._umax_next: float | None = None
-        # fast-path QoI packs awaiting their host read; depth 1 normally
-        # (read at end of the producing step), depth 2 when cfg.pipelined
-        # (read one step late by a worker thread, overlapping the transfer
-        # with the next step's device work)
-        self._pack_queue: List[dict] = []
-        self._reader = None  # pipelined-mode consumer thread
+        # pipelined mode: grouped deferred reads (sim/pack.py) — K packs
+        # concatenate on device into ONE worker-thread fetch, amortizing
+        # the tunnel's per-read latency; non-pipelined runs consume each
+        # pack at the end of its own step
+        from cup3d_tpu.sim.pack import GroupedPackReader
+
+        self._pack_reader = GroupedPackReader(self._consume_pack)
 
     # -- setup (reference init(), main.cpp:15163-15178) --------------------
 
@@ -106,8 +107,8 @@ class Simulation:
             umax = self._umax_next
             if not self.cfg.pipelined:
                 self._umax_next = None
-            # pipelined: keep the latest consumed max|u| (the reader thread
-            # may still be in flight); staleness is bounded by two steps
+            # pipelined: keep the latest consumed max|u| — staleness is
+            # bounded by ~2x the grouped-read cadence (sim/pack.py)
         else:
             umax = float(self._max_u(s.state["vel"], s.uinf_device()))
         if umax > cfg.uMax_allowed:
@@ -121,7 +122,13 @@ class Simulation:
             cfl = cfg.CFL
             if s.step < cfg.rampup:  # logarithmic ramp 1e-2*CFL -> CFL
                 cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - s.step / cfg.rampup))
+            prev_dt = s.dt
             dt_adv = cfl * h / max(umax, 1e-12)
+            if cfg.pipelined and prev_dt > 0:
+                # max|u| may be ~2x the grouped-read cadence stale in
+                # pipelined mode: bounding dt growth keeps an accelerating
+                # flow inside the CFL limit until a fresher value lands
+                dt_adv = min(dt_adv, 1.1 * prev_dt)
             if cfg.implicitDiffusion:
                 # a from-rest flow is diffusion-dominated: keep the explicit
                 # cap until any velocity scale exists, else dt_adv blows up
@@ -176,57 +183,23 @@ class Simulation:
                 op(dt)
         if s.pending_parts:
             with s.profiler("SyncQoI"):
-                self._emit_step_pack()
+                entry = self._emit_step_pack()
                 if self.cfg.pipelined:
-                    # overlap the blocking host read with the next step's
-                    # dispatch: a worker thread performs ONLY the transfer
-                    # (no shared-state writes); the main thread applies the
-                    # fetched values here, so mirrors never tear.  Joining
-                    # is instant in steady state — the worker had a full
-                    # step of wall-clock to finish one transfer.
-                    self._join_reader()
-                    if len(self._pack_queue) >= 2:
-                        entry = self._pack_queue.pop(0)
-                        import threading
-
-                        th = threading.Thread(
-                            target=self._fetch_entry, args=(entry,)
-                        )
-                        th.start()
-                        self._reader = (th, entry)
+                    # grouped deferred read (sim/pack.py): the transfer of
+                    # K packs overlaps later steps' device work; mirrors
+                    # are applied strictly FIFO on the main thread
+                    self._pack_reader.emit(entry)
                 else:
-                    while self._pack_queue:
-                        self._consume_pack(self._pack_queue.pop(0))
+                    self._consume_pack(entry)
         s.step += 1
         s.time += dt
 
-    @staticmethod
-    def _fetch_entry(entry: dict) -> None:
-        """Worker-thread body: blocking device->host transfer only."""
-        try:
-            entry["vals"] = np.asarray(entry["pack"], np.float64)
-        except BaseException as e:  # re-raised on the main thread at join
-            entry["err"] = e
-
-    def _join_reader(self) -> None:
-        """Join the in-flight transfer and apply it on the main thread
-        (re-raising any transfer failure instead of losing it)."""
-        if self._reader is None:
-            return
-        th, entry = self._reader
-        self._reader = None
-        th.join()
-        if "err" in entry:
-            raise entry["err"]
-        self._consume_pack(entry)
-
-    def _emit_step_pack(self) -> None:
+    def _emit_step_pack(self) -> dict:
         """Concatenate every device QoI the step produced (rigid state,
         forces, penalization forces) plus max|u| for a later dt into ONE
-        device vector and start its device->host transfer (fast path; see
-        models/base.rigid_update_device).  Non-pipelined runs read it back
-        immediately (advance); pipelined runs read it one step later, so
-        the transfer overlaps the next step's device work."""
+        device vector (fast path; see models/base.rigid_update_device).
+        Non-pipelined runs read the entry back immediately (advance);
+        pipelined runs hand it to the grouped reader."""
         import jax.numpy as jnp
 
         s = self.sim
@@ -243,10 +216,10 @@ class Simulation:
             pack.copy_to_host_async()
         except Exception:
             pass  # experimental platforms may lack async copies
-        self._pack_queue.append(
-            {"layout": [(n, a.shape[0]) for n, a in parts], "pack": pack,
-             "time": s.time}
-        )
+        return {
+            "layout": [(n, a.shape[0]) for n, a in parts], "pack": pack,
+            "time": s.time,
+        }
 
     def _consume_pack(self, entry: dict) -> None:
         """Read one emitted pack (or reuse the worker's fetch) and refresh
@@ -280,9 +253,7 @@ class Simulation:
     def flush_packs(self) -> None:
         """Drain pending QoI packs so host mirrors are current — called
         before dumps, checkpoints, and at run end (pipelined mode)."""
-        self._join_reader()
-        while self._pack_queue:
-            self._consume_pack(self._pack_queue.pop(0))
+        self._pack_reader.flush()
 
     def simulate(self) -> None:
         s, cfg = self.sim, self.cfg

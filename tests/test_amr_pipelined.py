@@ -39,7 +39,7 @@ def test_pipelined_matches_host_path(adapt):
     exercising the flush + chain-restart boundary."""
     pipe = _run(True, adapt=adapt)
     ref = _run(False, adapt=adapt)
-    assert not pipe._pack_queue and pipe._reader is None  # flushed
+    assert not pipe._pack_reader  # flushed
     assert pipe.grid.nb == ref.grid.nb
     for op, orf in zip(pipe.obstacles, ref.obstacles):
         np.testing.assert_allclose(op.position, orf.position,

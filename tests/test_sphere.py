@@ -125,7 +125,7 @@ def test_pipelined_mode_matches_default():
 
     pipe, ref = run(True), run(False)
     op, orf = pipe.sim.obstacles[0], ref.sim.obstacles[0]
-    assert not pipe._pack_queue  # flushed at run end
+    assert not pipe._pack_reader  # flushed at run end
     np.testing.assert_allclose(op.transVel, orf.transVel, rtol=1e-6, atol=1e-8)
     np.testing.assert_allclose(op.position, orf.position, rtol=1e-7, atol=1e-9)
     # forces on the co-moving sphere are ~1e-7 (noise floor of f32 sums
