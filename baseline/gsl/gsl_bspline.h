@@ -1,0 +1,88 @@
+/* Minimal GSL replacements (original code) for building the reference
+ * single-host — see baseline/mpi.h for why.  Implements only the exact
+ * calls the reference makes: cubic B-spline basis evaluation on uniform
+ * knots (Cox–de Boor) and the vector plumbing around it. */
+#ifndef STUB_GSL_BSPLINE_H
+#define STUB_GSL_BSPLINE_H
+
+#include <cstdlib>
+#include <vector>
+
+struct gsl_vector {
+  std::vector<double> v;
+  double *data;
+  size_t size;
+};
+inline gsl_vector *gsl_vector_alloc(size_t n) {
+  gsl_vector *x = new gsl_vector();
+  x->v.assign(n, 0.0);
+  x->data = x->v.data();
+  x->size = n;
+  return x;
+}
+inline void gsl_vector_free(gsl_vector *x) { delete x; }
+inline double gsl_vector_get(const gsl_vector *x, size_t i) {
+  return x->v[i];
+}
+inline void gsl_vector_set(gsl_vector *x, size_t i, double val) {
+  x->v[i] = val;
+}
+
+struct gsl_bspline_workspace {
+  int k;        /* spline order (degree + 1) */
+  int nbreak;
+  int ncoeff;
+  std::vector<double> knots; /* clamped: k-fold end knots */
+};
+
+inline gsl_bspline_workspace *gsl_bspline_alloc(size_t k, size_t nbreak) {
+  gsl_bspline_workspace *w = new gsl_bspline_workspace();
+  w->k = (int)k;
+  w->nbreak = (int)nbreak;
+  w->ncoeff = (int)(nbreak + k - 2);
+  return w;
+}
+inline void gsl_bspline_free(gsl_bspline_workspace *w) { delete w; }
+
+inline int gsl_bspline_knots_uniform(double a, double b,
+                                     gsl_bspline_workspace *w) {
+  const int k = w->k, nb = w->nbreak;
+  w->knots.clear();
+  for (int i = 0; i < k - 1; i++) w->knots.push_back(a);
+  for (int i = 0; i < nb; i++)
+    w->knots.push_back(a + (b - a) * (double)i / (double)(nb - 1));
+  for (int i = 0; i < k - 1; i++) w->knots.push_back(b);
+  return 0;
+}
+
+/* Cox–de Boor recursion over the full clamped knot vector. */
+inline int gsl_bspline_eval(double x, gsl_vector *B,
+                            gsl_bspline_workspace *w) {
+  const int k = w->k;
+  const int n = w->ncoeff;
+  const std::vector<double> &t = w->knots;
+  const int nk = (int)t.size();
+  std::vector<double> N(nk - 1, 0.0);
+  /* clamp x into the support so the endpoint evaluates to the last basis */
+  if (x <= t.front()) x = t.front();
+  if (x >= t.back()) {
+    for (int j = 0; j < n; j++) gsl_vector_set(B, j, j == n - 1 ? 1.0 : 0.0);
+    return 0;
+  }
+  for (int i = 0; i < nk - 1; i++)
+    N[i] = (t[i] <= x && x < t[i + 1]) ? 1.0 : 0.0;
+  for (int d = 2; d <= k; d++) {
+    for (int i = 0; i + d < nk; i++) {
+      double left = 0.0, right = 0.0;
+      double den1 = t[i + d - 1] - t[i];
+      double den2 = t[i + d] - t[i + 1];
+      if (den1 > 0.0) left = (x - t[i]) / den1 * N[i];
+      if (den2 > 0.0) right = (t[i + d] - x) / den2 * N[i + 1];
+      N[i] = left + right;
+    }
+  }
+  for (int j = 0; j < n; j++) gsl_vector_set(B, j, N[j]);
+  return 0;
+}
+
+#endif

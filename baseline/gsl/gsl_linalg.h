@@ -1,0 +1,109 @@
+/* Minimal GSL linalg replacement (original code): LU with partial
+ * pivoting for the reference's dense 6x6 momentum solve
+ * (main.cpp:13013-13027).  Only the exact entry points used. */
+#ifndef STUB_GSL_LINALG_H
+#define STUB_GSL_LINALG_H
+
+#include <cmath>
+#include <cstdlib>
+#include <vector>
+
+#include <gsl/gsl_bspline.h> /* gsl_vector */
+
+struct gsl_matrix {
+  double *data;
+  size_t size1, size2;
+};
+struct gsl_matrix_view {
+  gsl_matrix matrix;
+};
+struct gsl_vector_stub_ref {
+  double *data;
+  size_t size;
+  std::vector<double> own;
+};
+struct gsl_vector_view {
+  gsl_vector vector;
+};
+struct gsl_permutation {
+  std::vector<size_t> idx;
+};
+
+inline gsl_matrix_view gsl_matrix_view_array(double *a, size_t n1, size_t n2) {
+  gsl_matrix_view v;
+  v.matrix.data = a;
+  v.matrix.size1 = n1;
+  v.matrix.size2 = n2;
+  return v;
+}
+inline gsl_vector_view gsl_vector_view_array(double *a, size_t n) {
+  gsl_vector_view v;
+  v.vector.v.clear();
+  v.vector.data = a;
+  v.vector.size = n;
+  return v;
+}
+inline gsl_permutation *gsl_permutation_alloc(size_t n) {
+  gsl_permutation *p = new gsl_permutation();
+  p->idx.resize(n);
+  for (size_t i = 0; i < n; i++) p->idx[i] = i;
+  return p;
+}
+inline void gsl_permutation_free(gsl_permutation *p) { delete p; }
+
+inline int gsl_linalg_LU_decomp(gsl_matrix *A, gsl_permutation *p, int *sig) {
+  const size_t n = A->size1;
+  double *a = A->data;
+  *sig = 1;
+  for (size_t i = 0; i < n; i++) p->idx[i] = i;
+  for (size_t c = 0; c < n; c++) {
+    size_t piv = c;
+    double best = std::fabs(a[c * n + c]);
+    for (size_t r = c + 1; r < n; r++) {
+      double v = std::fabs(a[r * n + c]);
+      if (v > best) { best = v; piv = r; }
+    }
+    if (piv != c) {
+      for (size_t j = 0; j < n; j++) {
+        double t = a[c * n + j];
+        a[c * n + j] = a[piv * n + j];
+        a[piv * n + j] = t;
+      }
+      size_t t = p->idx[c];
+      p->idx[c] = p->idx[piv];
+      p->idx[piv] = t;
+      *sig = -*sig;
+    }
+    double d = a[c * n + c];
+    if (d == 0.0) continue;
+    for (size_t r = c + 1; r < n; r++) {
+      double f = a[r * n + c] / d;
+      a[r * n + c] = f;
+      for (size_t j = c + 1; j < n; j++) a[r * n + j] -= f * a[c * n + j];
+    }
+  }
+  return 0;
+}
+
+inline int gsl_linalg_LU_solve(const gsl_matrix *A, const gsl_permutation *p,
+                               const gsl_vector *b, gsl_vector *x) {
+  const size_t n = A->size1;
+  const double *a = A->data;
+  const double *bd = b->v.empty() ? b->data : b->v.data();
+  double *xd = x->v.empty() ? x->data : x->v.data();
+  std::vector<double> y(n);
+  for (size_t i = 0; i < n; i++) {
+    double s = bd[p->idx[i]];
+    for (size_t j = 0; j < i; j++) s -= a[i * n + j] * y[j];
+    y[i] = s;
+  }
+  for (size_t ii = n; ii-- > 0;) {
+    double s = y[ii];
+    for (size_t j = ii + 1; j < n; j++) s -= a[ii * n + j] * xd[j];
+    double d = a[ii * n + ii];
+    xd[ii] = d != 0.0 ? s / d : 0.0;
+  }
+  return 0;
+}
+
+#endif

@@ -1,0 +1,1 @@
+/* empty: included but unused by the reference */

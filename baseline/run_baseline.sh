@@ -1,0 +1,39 @@
+#!/bin/sh
+# Times the reference (built per README.md) on the two BASELINE anchor
+# configs and appends JSON records to ../validation/results/baseline.jsonl.
+set -e
+cd "$(dirname "$0")"
+mkdir -p runout ../validation/results
+cd runout
+
+run_case() {
+  name="$1"; shift
+  start=$(date +%s.%N)
+  OMP_NUM_THREADS=1 ../ref_main "$@" > "$name.log" 2>&1
+  end=$(date +%s.%N)
+  steps=$(grep -c "step:" "$name.log" || true)
+  python3 - "$name" "$start" "$end" "$steps" << 'EOF'
+import json, sys
+name, t0, t1, steps = sys.argv[1], float(sys.argv[2]), float(sys.argv[3]), int(sys.argv[4])
+wall = t1 - t0
+rec = {"case": name, "steps": steps, "wall_s": round(wall, 2),
+       "s_per_step": round(wall / max(steps, 1), 3),
+       "omp_threads": 1, "note": "serial-MPI stub build, see baseline/README.md"}
+with open("../../validation/results/baseline.jsonl", "a") as f:
+    f.write(json.dumps(rec) + "\n")
+print(json.dumps(rec))
+EOF
+}
+
+run_case runsh_two_fish_amr \
+  -bMeanConstraint 2 -bpdx 1 -bpdy 1 -bpdz 1 -CFL 0.4 -Ctol 0.1 -extentx 1 \
+  -factory-content 'StefanFish L=0.4 T=1.0 xpos=0.2 ypos=0.5 zpos=0.5 planarAngle=180 heightProfile=danio widthProfile=stefan bFixFrameOfRef=1
+ StefanFish L=0.4 T=1.0 xpos=0.7 ypos=0.5 zpos=0.5 heightProfile=danio widthProfile=stefan' \
+  -levelMax 4 -levelStart 3 -nu 0.001 -poissonSolver iterative -Rtol 5 \
+  -tdump 0 -tend 0.2
+
+run_case uniform128_fish \
+  -bMeanConstraint 2 -bpdx 16 -bpdy 16 -bpdz 16 -CFL 0.4 -extentx 1 \
+  -factory-content 'StefanFish L=0.4 T=1.0 xpos=0.5 ypos=0.5 zpos=0.5 bFixFrameOfRef=1 heightProfile=danio widthProfile=stefan' \
+  -levelMax 1 -levelStart 0 -nu 0.001 -poissonSolver iterative \
+  -tdump 0 -nsteps 25 -tend 10

@@ -62,7 +62,13 @@ class SimulationConfig:
     # -- pressure solve (main.cpp:15364-15368) --
     poissonTol: float = 1e-6
     poissonTolRel: float = 1e-4
-    bMeanConstraint: int = 1
+    # nullspace handling (ops/amr_ops.build_amr_poisson_solver): 0 none,
+    # 1 pin-corner-row-to-mean, 2 mean projection, 3 Dirichlet pin.
+    # Deliberate divergence: the reference defaults to 1
+    # (main.cpp:15366); we default to 2 — identical physics up to the
+    # nullspace constant, but the projection keeps the Krylov operator
+    # uniform (no special row), which converges slightly faster here.
+    bMeanConstraint: int = 2
     poissonSolver: str = "spectral"  # spectral (uniform) | iterative (AMR)
 
     # -- diffusion solve (main.cpp:15369-15371) --

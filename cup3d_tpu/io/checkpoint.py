@@ -78,9 +78,13 @@ def save_checkpoint(driver, path: Optional[str] = None) -> str:
     return path
 
 
-def load_checkpoint(path: str):
+def load_checkpoint(path: str, mesh=None):
     """Rebuild the driver (AMRSimulation or Simulation) from a checkpoint,
-    ready to continue stepping."""
+    ready to continue stepping.  ``mesh`` (a 1-D jax Mesh) restores an AMR
+    checkpoint INTO sharded (mesh) mode: fields are padded + sharded over
+    the device mesh exactly as a fresh mesh-mode run lays them out —
+    checkpoints themselves are layout-free (unpadded numpy), so saves from
+    single-device runs restore sharded and vice versa."""
     from cup3d_tpu.config import SimulationConfig
 
     with open(path, "rb") as f:
@@ -102,9 +106,10 @@ def load_checkpoint(path: str):
         for l, i, j, k in payload["leaves"]:
             tree.leaves[(int(l), int(i), int(j), int(k))] = None
         tree.assert_balanced()
-        driver = AMRSimulation(cfg, tree=tree)
+        driver = AMRSimulation(cfg, tree=tree, mesh=mesh)
         driver.state = {
-            k: jnp.asarray(v, driver.dtype) for k, v in payload["fields"].items()
+            k: driver._pad(jnp.asarray(v, driver.dtype))
+            for k, v in payload["fields"].items()
         }
         driver.time = payload["time"]
         driver.step_idx = payload["step"]

@@ -240,9 +240,16 @@ class Obstacle:
         """Advance internal deformation kinematics (fish midline etc.)."""
 
     def create(self, t: float) -> None:
-        """SDF -> chi + udef (reference Obstacle::create + chi kernel)."""
+        """SDF -> chi + udef (reference Obstacle::create + chi kernel).
+        The SDF is kept: the surface-point force probe (ops/surface.py)
+        takes its outward normals from grad(phi) like the reference."""
+        from cup3d_tpu.ops.chi import towers_chi
+
         sdf, udef = self.rasterize(t)
-        self.chi = heaviside(sdf, self.sim.grid.h)
+        self.sdf = sdf
+        self.chi = towers_chi(
+            self.sim.grid.pad_scalar(sdf, 1), self.sim.grid.h
+        )
         self.udef = udef if udef is not None else jnp.zeros(
             self.sim.grid.shape + (3,), self.sim.dtype
         )

@@ -219,8 +219,11 @@ class StefanFish(Obstacle):
         dtype = self.sim.dtype
         bs = grid.bs
         # fish AABB around the body center, padded per block by the
-        # mollification band at that block's spacing
-        half = 0.625 * self.length + 8.0 * grid.h  # (nb,)
+        # mollification band at that block's spacing (the same margin the
+        # surface-probe windows use — ops/surface.probe_margin)
+        from cup3d_tpu.ops.surface import probe_margin
+
+        half = probe_margin(self.length, grid.h)  # (nb,)
         lo = grid.origin  # (nb, 3)
         hi = grid.origin + (bs * grid.h)[:, None]
         cand = np.all(hi > self.position - half[:, None], axis=1) & np.all(
@@ -237,7 +240,8 @@ class StefanFish(Obstacle):
             xc = jnp.asarray(grid.cell_centers(dtype))
         # position/rotation from the device rigid chain in pipelined mode
         # (exact current state; the host mirror above only sizes the AABB,
-        # whose mollification margin covers its <=3-step staleness)
+        # whose 8h margin covers the grouped-read staleness of ~8 steps x
+        # CFL*h of drift — see ops/surface.probe_margin)
         pos, rot = self.pos_rot_device(dtype)
         return _raster_scatter_blocks(
             xc, jnp.asarray(idx_pad, jnp.int32), self._midline_device(),
@@ -263,8 +267,13 @@ class StefanFish(Obstacle):
         )
 
     def create(self, t: float) -> None:
+        from cup3d_tpu.ops.chi import towers_chi
+
         sdf, udef = self.rasterize(t)
-        self.chi = heaviside(sdf, self.sim.grid.h)
+        self.sdf = sdf
+        self.chi = towers_chi(
+            self.sim.grid.pad_scalar(sdf, 1), self.sim.grid.h
+        )
         # deformation velocity only matters inside the mollified band
         self.udef = udef * (self.chi > 0)[..., None]
 

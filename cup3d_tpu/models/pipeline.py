@@ -129,6 +129,12 @@ class UpdateObstacles(Operator):
             ob._ubody_cache = None
             s.pending_parts.append(("rigid", out))
             return
+        # host fallback: pipelined mode must never land here with a live
+        # device chain — the host mirrors trail the chain and would feed a
+        # stale state into compute_velocities (ADVICE r2)
+        assert not s.cfg.pipelined or all(
+            ob._dev_rigid is None for ob in s.obstacles
+        ), "pipelined host fallback with live device rigid chains"
         M = np.asarray(M)
         for ob, row in zip(s.obstacles, M):
             ob.compute_velocities(unpack_moments(row))
@@ -185,50 +191,45 @@ class Penalization(Operator):
 
 
 class ComputeForces(Operator):
-    """Surface tractions -> per-obstacle force/torque/power QoI, appended to
+    """Per-obstacle force/torque/power QoI from the surface-point probe
+    (ops/surface.py: one-sided tractions probed outside the body on a
+    dense window, the reference KernelComputeForces measure), appended to
     forces_<i>.txt (reference ComputeForces, main.cpp:12496-12503,
-    reduction 13079-13115)."""
-
-    def __init__(self, sim: SimulationData):
-        super().__init__(sim)
-        # ALL obstacles' force QoI in one (n_obs, 13) host read per step
-        self._forces = jax.jit(
-            lambda chis, p, vel, cms, ubodies, udefs, vunits: jnp.stack(
-                [
-                    pack_forces(
-                        force_integrals(sim.grid, c, p, vel, sim.nu,
-                                        cms[i], ubodies[i], udefs[i],
-                                        vunits[i])
-                    )
-                    for i, c in enumerate(chis)
-                ]
-            )
-        )
+    reduction 13079-13115).  The dense chi-band integral
+    (models.base.force_integrals) stays available for diagnostics but the
+    probe is the production measure — the band under-reads pressure by a
+    flat ~28% on the sphere (VALIDATION.md)."""
 
     def __call__(self, dt):
+        from cup3d_tpu.ops.surface import force_integrals_probe_uniform
+
         s = self.sim
+
+        def probe(ob, cm, ut, om):
+            return pack_forces(
+                force_integrals_probe_uniform(
+                    s.grid, ob, s.state["vel"], s.state["p"], ob.chi,
+                    ob.sdf, ob.udef, s.nu, cm, ut, om,
+                )
+            )
+
         if _device_step(s):
             ob = s.obstacles[0]
             d = ob._dev_rigid
-            F = self._forces(
-                (ob.chi,), s.state["p"], s.state["vel"], d["cm"][None],
-                (ob.body_velocity_field(),), (ob.udef,),
-                vel_unit_dev(d["trans"])[None],
-            )
+            F = probe(ob, d["cm"], d["trans"], d["ang"])
             s.pending_parts.append(("forces", F.reshape(-1)))
             return
-        cms = jnp.asarray(
-            np.stack([ob.centerOfMass for ob in s.obstacles]), s.dtype
-        )
-        vunits = jnp.asarray(
-            np.stack([vel_unit(ob.transVel) for ob in s.obstacles]), s.dtype
-        )
         F = np.asarray(
-            self._forces(
-                tuple(ob.chi for ob in s.obstacles), s.state["p"],
-                s.state["vel"], cms,
-                tuple(ob.body_velocity_field() for ob in s.obstacles),
-                tuple(ob.udef for ob in s.obstacles), vunits,
+            jnp.stack(
+                [
+                    probe(
+                        ob,
+                        jnp.asarray(ob.centerOfMass, s.dtype),
+                        jnp.asarray(ob.transVel, s.dtype),
+                        jnp.asarray(ob.angVel, s.dtype),
+                    )
+                    for ob in s.obstacles
+                ]
             )
         )
         for i, (ob, row) in enumerate(zip(s.obstacles, F)):

@@ -224,11 +224,21 @@ def build_amr_poisson_solver(
     flux_tab: Optional[FluxTables] = None,
     vol: Optional[jnp.ndarray] = None,
     pmask: Optional[jnp.ndarray] = None,
+    mean_constraint: int = 2,
 ):
     """getZ-preconditioned BiCGSTAB on the AMR forest: the direct TPU
-    analogue of PoissonSolverAMR (main.cpp:14363-14616).  The nullspace of
-    the all-Neumann/periodic operator is removed with *volume-weighted*
-    means (blocks at different levels weigh h^3 differently).
+    analogue of PoissonSolverAMR (main.cpp:14363-14616).
+
+    ``mean_constraint`` mirrors the reference's bMeanConstraint
+    (ComputeLHS, main.cpp:9273-9327):
+
+    - 0: no nullspace handling (caller guarantees compatibility);
+    - 1: the equation row of cell (0,0,0) of the corner block is
+      replaced by the volume-weighted mean of the unknown;
+    - 2 (default): mean removal — the projection formulation of the
+      reference's rank-one "LHS += avg * h^3" shift;
+    - 3 (reference: any value > 2): Dirichlet-pin — the corner row is
+      replaced by the identity, fixing p at that cell.
 
     ``tab``/``flux_tab`` may be pre-built (or the sharded forest's
     duck-typed equivalents); ``vol`` overrides the per-block cell volume
@@ -248,6 +258,13 @@ def build_amr_poisson_solver(
         )
     vol_total = jnp.sum(vol) * grid.bs**3
     h2 = jnp.asarray((grid.h**2).reshape(grid.nb, 1, 1, 1), jnp.float32)
+    # corner block: the reference pins block .index == (0,0,0); in the
+    # Hilbert-ordered forest that is the leaf covering the domain corner
+    slot0 = int(
+        np.lexsort(
+            (grid.ijk[:, 2], grid.ijk[:, 1], grid.ijk[:, 0])
+        )[0]
+    ) if mean_constraint in (1, 3) else 0
 
     def wmean(x):
         return jnp.sum(x * vol) / vol_total
@@ -257,6 +274,17 @@ def build_amr_poisson_solver(
         # main.cpp:14617-14746); blocks are already bs^3 tiles
         return krylov.block_cg_tiles(-h2 * r, precond_iters)
 
+    def A_of(t, ft):
+        if mean_constraint == 1:
+            return lambda x_: laplacian_blocks(grid, x_, t, ft).at[
+                slot0, 0, 0, 0
+            ].set(wmean(x_) * vol_total)
+        if mean_constraint == 3:
+            return lambda x_: laplacian_blocks(grid, x_, t, ft).at[
+                slot0, 0, 0, 0
+            ].set(x_[slot0, 0, 0, 0])
+        return lambda x_: laplacian_blocks(grid, x_, t, ft)
+
     def solve(rhs, x0=None, tab_arg=None, flux_arg=None, rnorm_ref=None):
         # callers under jit pass the tables as traced ARGUMENTS so they
         # are runtime buffers, not constants embedded in the lowered HLO
@@ -264,7 +292,13 @@ def build_amr_poisson_solver(
         # tables are the fallback for direct use
         t = tab if tab_arg is None else tab_arg
         ft = flux_tab if flux_arg is None else flux_arg
-        b = rhs - wmean(rhs)
+        if mean_constraint == 2:
+            b = rhs - wmean(rhs)
+        elif mean_constraint in (1, 3):
+            # pinned row: its RHS is the pin target (0 = zero mean / p=0)
+            b = rhs.at[slot0, 0, 0, 0].set(0.0)
+        else:
+            b = rhs
         if pmask is not None:
             b = b * pmask
         if rnorm_ref is None:
@@ -272,11 +306,12 @@ def build_amr_poisson_solver(
             # callers pass the cold RHS norm (see krylov.bicgstab)
             rnorm_ref = jnp.sqrt(jnp.sum(b * b, dtype=jnp.float32))
         x, rnorm, k = krylov.bicgstab(
-            lambda x_: laplacian_blocks(grid, x_, t, ft), b, M=M, x0=x0,
+            A_of(t, ft), b, M=M, x0=x0,
             tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter,
             rnorm_ref=rnorm_ref,
         )
-        x = x - wmean(x)
+        if mean_constraint == 2:
+            x = x - wmean(x)
         return x * pmask if pmask is not None else x
 
     return solve

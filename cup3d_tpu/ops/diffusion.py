@@ -218,7 +218,13 @@ def build_amr_helmholtz_solver(
     h2 = jnp.asarray((grid.h**2).reshape(grid.nb, 1, 1, 1), jnp.float32)
     inv_h = 1.0 / jnp.sqrt(h2)
 
-    def solve(u: jnp.ndarray, nudt) -> jnp.ndarray:
+    def solve(u: jnp.ndarray, nudt, tab_arg=None, flux_arg=None
+              ) -> jnp.ndarray:
+        # like the Poisson front-end, jitted callers pass the tables as
+        # traced ARGUMENTS so they are runtime buffers, not HLO constants
+        # (compile-payload rule; ADVICE r2)
+        t = tab if tab_arg is None else tab_arg
+        ft = flux_tab if flux_arg is None else flux_arg
         shift = h2 / nudt  # per-block; reference coefficient -6 - h^2/(nu dt)
         outs = []
         for c in range(3):
@@ -226,7 +232,7 @@ def build_amr_helmholtz_solver(
 
             def A(x, _c=c):
                 return helmholtz_comp_blocks(
-                    grid, x, tab, nudt, _c, flux_tab, inv_h
+                    grid, x, t, nudt, _c, ft, inv_h
                 )
 
             def M(r):

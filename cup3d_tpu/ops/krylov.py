@@ -394,13 +394,16 @@ def build_iterative_solver(
     maxiter: int = 1000,
     precond_bs: int = 8,
     precond_iters: int = 24,
+    mean_constraint: int = 2,
 ) -> Callable:
-    """solve(rhs) -> p with mean(p)=0, via getZ-preconditioned BiCGSTAB.
+    """solve(rhs) -> p via getZ-preconditioned BiCGSTAB.
 
-    The all-Neumann/periodic Laplacian is singular (constants); we project
-    the nullspace out of the rhs and the answer, the same role as the
-    reference's bMeanConstraint / global mean subtraction
-    (main.cpp:9273-9327, 15109-15134).
+    ``mean_constraint`` mirrors the reference's bMeanConstraint
+    (ComputeLHS, main.cpp:9273-9327): 0 = none, 1 = the equation row of
+    cell (0,0,0) becomes the volume-weighted mean of the unknown, 2 =
+    nullspace projection (mean removal; default), 3 = Dirichlet-pin of
+    cell (0,0,0).  The pinned-row RHS is zeroed like the reference's
+    solve loop (main.cpp:14404-14407).
 
     The solve runs in the lane-resident tile layout (to_lanes /
     make_laplacian_lanes): one transpose in, one out, none per iteration.
@@ -409,17 +412,32 @@ def build_iterative_solver(
 
     if any(s % precond_bs for s in grid.shape):
         return _build_iterative_solver_dense(
-            grid, tol_abs, tol_rel, maxiter, precond_bs, precond_iters
+            grid, tol_abs, tol_rel, maxiter, precond_bs, precond_iters,
+            mean_constraint,
         )
-    A = make_laplacian_lanes(grid, precond_bs)
+    A0 = make_laplacian_lanes(grid, precond_bs)
     h2 = grid.h * grid.h
+    h3 = grid.h ** 3
+
+    # lanes layout: dense cell (0,0,0) lives at [0,0,0, lane 0]
+    if mean_constraint == 1:
+        A = lambda t: A0(t).at[0, 0, 0, 0].set(jnp.sum(t) * h3)
+    elif mean_constraint == 3:
+        A = lambda t: A0(t).at[0, 0, 0, 0].set(t[0, 0, 0, 0])
+    else:
+        A = A0
 
     def M(r):
         return cg_tiles_lanes(-h2 * r, precond_iters)
 
     def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-        b = rhs - jnp.mean(rhs)
+        if mean_constraint == 2:
+            b = rhs - jnp.mean(rhs)
+        else:
+            b = rhs
         bt = to_lanes(b, precond_bs)
+        if mean_constraint in (1, 3):
+            bt = bt.at[0, 0, 0, 0].set(0.0)
         x0t = None if x0 is None else to_lanes(x0, precond_bs)
         # rel tolerance always references the cold system's RHS norm so a
         # warm start can only reduce iterations (see bicgstab docstring)
@@ -428,7 +446,7 @@ def build_iterative_solver(
             maxiter=maxiter, rnorm_ref=jnp.sqrt(_dot(bt, bt)),
         )
         x = from_lanes(xt, rhs.shape)
-        return x - jnp.mean(x)
+        return x - jnp.mean(x) if mean_constraint == 2 else x
 
     return solve
 
@@ -440,17 +458,27 @@ def _build_iterative_solver_dense(
     maxiter: int = 1000,
     precond_bs: int = 8,
     precond_iters: int = 24,
+    mean_constraint: int = 2,
 ) -> Callable:
     """Dense-layout fallback (grids not divisible by the tile size)."""
-    A = make_laplacian(grid)
+    A0 = make_laplacian(grid)
     M = make_block_cg_preconditioner(precond_bs, precond_iters, h=grid.h)
+    h3 = grid.h ** 3
+    if mean_constraint == 1:
+        A = lambda x: A0(x).at[0, 0, 0].set(jnp.sum(x) * h3)
+    elif mean_constraint == 3:
+        A = lambda x: A0(x).at[0, 0, 0].set(x[0, 0, 0])
+    else:
+        A = A0
 
     def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-        b = rhs - jnp.mean(rhs)
+        b = rhs - jnp.mean(rhs) if mean_constraint == 2 else rhs
+        if mean_constraint in (1, 3):
+            b = b.at[0, 0, 0].set(0.0)
         x, _, _ = bicgstab(
             A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel,
             maxiter=maxiter, rnorm_ref=jnp.sqrt(_dot(b, b)),
         )
-        return x - jnp.mean(x)
+        return x - jnp.mean(x) if mean_constraint == 2 else x
 
     return solve

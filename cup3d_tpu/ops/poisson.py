@@ -93,18 +93,22 @@ def _apply_mat(mat, f, axis):
 
 def make_poisson_solver(grid: UniformGrid, kind: str = "spectral",
                         dtype=jnp.float32, tol_abs: float = 1e-6,
-                        tol_rel: float = 1e-4, maxiter: int = 1000) -> Callable:
+                        tol_rel: float = 1e-4, maxiter: int = 1000,
+                        mean_constraint: int = 2) -> Callable:
     """Factory mirroring the reference's makePoissonSolver
     (main.cpp:14747-14758): "spectral" = exact uniform-grid diagonalization
     (this module); "iterative" = getZ-preconditioned BiCGSTAB
-    (cup3d_tpu.ops.krylov), the path that generalizes to AMR."""
+    (cup3d_tpu.ops.krylov), the path that generalizes to AMR.
+    ``mean_constraint`` = the reference's bMeanConstraint for the
+    iterative path; the spectral solve is mean-free by construction."""
     if kind == "spectral":
         return build_spectral_solver(grid, dtype)
     if kind == "iterative":
         from cup3d_tpu.ops.krylov import build_iterative_solver
 
         return build_iterative_solver(
-            grid, tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter
+            grid, tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter,
+            mean_constraint=mean_constraint,
         )
     raise ValueError(f"unknown poissonSolver {kind!r}")
 

@@ -202,9 +202,14 @@ def surface_force_window(
         full = (s1 * s2)[..., None] * (
             -0.5 * row(2) + 2.0 * row(1) - 1.5 * row(0)
         )
+        # deliberate divergence: the reference's compact fallback applies
+        # the sign product to only the first difference
+        # (main.cpp:12399-12401), inverting one term whenever the two
+        # normal signs differ; we use the mathematically consistent form
         compact = (s1 * s2)[..., None] * (
-            vat(*at(1, 1)) - vat(*at(1, 0))
-        ) - (vat(*at(0, 1)) - vat(*at(0, 0)))
+            (vat(*at(1, 1)) - vat(*at(1, 0)))
+            - (vat(*at(0, 1)) - vat(*at(0, 0)))
+        )
         ok = (inwin(*at(2, 0)) & inwin(*at(0, 2)))[..., None]
         return jnp.where(ok, full, compact)
 
@@ -242,8 +247,14 @@ def surface_force_window(
     force_par = jnp.sum(fT * vel_unit, -1)
     thrust = jnp.sum(0.5 * (force_par + jnp.abs(force_par)))
     drag = -jnp.sum(0.5 * (force_par - jnp.abs(force_par)))
+    # power = traction . FLUID velocity at the surface cell — the
+    # reference's Pout (main.cpp:12461); the old band measure used
+    # u_body here, a divergence this kernel removes.  p_locom is the
+    # reference's traction . u_solid work (main.cpp:12470-2476).
     pow_out = jnp.sum(fT * vel)
     def_power = jnp.sum(fT * udef)
+    u_solid = u_trans + jnp.cross(jnp.broadcast_to(omega, r.shape), r)
+    p_locom = jnp.sum(fT * u_solid)
     return {
         "pres_force": pres_force,
         "visc_force": visc_force,
@@ -252,6 +263,7 @@ def surface_force_window(
         "thrust": thrust,
         "drag": drag,
         "def_power": def_power,
+        "p_locom": p_locom,
     }
 
 
@@ -260,11 +272,21 @@ def surface_force_window(
 # ---------------------------------------------------------------------------
 
 
+def probe_margin(length: float, h: float) -> float:
+    """Half-extent of an obstacle's working AABB: body half-length plus an
+    8h band.  THE single source for the rasterizer's candidate search
+    (stefanfish._rasterize_blocks) and both probe windows — these must
+    stay mutually consistent or surface cells silently fall outside the
+    window.  8h also covers the pipelined host-mirror staleness (~8 steps
+    x CFL*h <= 3.2h of position drift, sim/pack.py)."""
+    return 0.625 * length + 8.0 * h
+
+
 def window_size_cells(length: float, h: float, bs: int = 8) -> int:
-    """Static window edge (cells): the rasterizer's AABB margin
-    (0.625 L + 8h), rounded up to whole blocks so AMR gathers stay
-    block-granular and jit retraces only on bucket changes."""
-    half = 0.625 * length + 8.0 * h
+    """Static window edge (cells): 2x probe_margin, rounded up to whole
+    blocks so AMR gathers stay block-granular and jit retraces only on
+    bucket changes."""
+    half = probe_margin(length, h)
     return int(-(-2.0 * half / h // bs) * bs)
 
 
@@ -313,17 +335,20 @@ def force_integrals_probe_uniform(grid, ob, vel, p, chi, sdf, udef, nu,
 def block_window_slots(grid, position: np.ndarray, length: float):
     """Host: finest-level block slots covering the obstacle AABB.
     Returns (slots (nbx,nby,nbz) int32 with -1 for positions not owned at
-    the finest level, window block origin (3,) ints, h_fine)."""
+    the finest level, window block origin (3,) ints, h_fine).
+
+    The window SIZE depends only on (length, h, domain) — never on the
+    position — so jitted consumers (the pipelined megastep) retrace only
+    on re-layouts, not when the body crosses a block boundary."""
     lmax = len(grid._slot_maps) - 1
     h = grid.h0 / (1 << lmax)
     bs = grid.bs
     nbd = np.asarray(grid.tree.blocks_per_dim(lmax))
-    half = 0.625 * length + 8.0 * h
+    half = probe_margin(length, h)
+    nwin = np.minimum(int(np.ceil(2.0 * half / (bs * h))) + 1, nbd)
     b0 = np.floor((position - half) / (bs * h)).astype(np.int64)
-    b1 = np.ceil((position + half) / (bs * h)).astype(np.int64)
-    b0 = np.clip(b0, 0, nbd - 1)
-    b1 = np.clip(b1, 1, nbd)
-    rng = [np.arange(b0[a], b1[a]) for a in range(3)]
+    b0 = np.clip(b0, 0, nbd - nwin)
+    rng = [np.arange(b0[a], b0[a] + nwin[a]) for a in range(3)]
     slots = grid._slot_maps[lmax][np.ix_(*rng)].astype(np.int32)
     return slots, b0, h
 

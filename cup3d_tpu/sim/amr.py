@@ -58,12 +58,28 @@ ADAPT_EVERY = 20  # reference cadence (main.cpp:15314)
 _EPS = 1e-6
 
 
-@partial(jax.jit, static_argnames=("combine",))
-def _combine_obstacle_fields(sdfs, udefs, h_raw, combine=True):
+@partial(jax.jit, static_argnames=("combine", "bs"))
+def _combine_obstacle_fields(sdfs, udefs, h_raw, combine=True, tab=None,
+                             bs=8):
     """(n_obs, nb, ...) sdf/udef stacks -> per-obstacle chi/masked-udef +
     (optionally) the chi-weighted combined fields, in one dispatch.  The
-    pipelined megastep recombines on device, so it passes combine=False."""
-    chis = heaviside(sdfs, h_raw[None])
+    pipelined megastep recombines on device, so it passes combine=False.
+
+    With ``tab`` (face tables) the chi is the reference's Towers
+    construction from the halo'd SDF (ops/chi.py towers_chi, +-1h band);
+    without neighbor data (sharded-forest create) the sine Heaviside
+    fallback keeps the old +-2h band."""
+    if tab is not None:
+        from cup3d_tpu.ops.chi import towers_chi
+
+        chis = jnp.stack(
+            [
+                towers_chi(tab.assemble_scalar(sdfs[i], bs), h_raw)
+                for i in range(sdfs.shape[0])
+            ]
+        )
+    else:
+        chis = heaviside(sdfs, h_raw[None])
     udefs = udefs * (chis > 0)[..., None]
     if not combine:
         return chis, udefs, None, None
@@ -177,7 +193,8 @@ class AMRSimulation:
             self._tab3 = self.forest.lab_tables(3)
             self._ftab = self.forest.flux_tables
             self._solver = self.forest.build_poisson_solver(
-                tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel
+                tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel,
+                mean_constraint=cfg.bMeanConstraint,
             )
             # padded geometry arrays; cell volume is 0 on padding blocks so
             # every volume-weighted reduction ignores them, and the padding
@@ -201,6 +218,7 @@ class AMRSimulation:
             self._solver = amr_ops.build_amr_poisson_solver(
                 g, tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel,
                 tab=self._tab1, flux_tab=self._ftab,
+                mean_constraint=cfg.bMeanConstraint,
             )
             self._h_col = jnp.asarray(
                 g.h.reshape(g.nb, 1, 1, 1), self.dtype
@@ -233,11 +251,17 @@ class AMRSimulation:
                 geom, tol_abs=cfg.diffusionTol, tol_rel=cfg.diffusionTolRel,
                 tab=self._tab1, flux_tab=self._ftab,
             )
+            # the Helmholtz tables travel as traced args too (ADVICE r2):
+            # the closure-built helm's captured tables stay unused
             self._advdiff = jit_bound(
-                lambda vel, dt, uinf, tab3: dif.implicit_step_blocks(
-                    geom, vel, dt, self.nu, uinf, tab3, helm
+                lambda vel, dt, uinf, tab3, tab1, ftab:
+                dif.implicit_step_blocks(
+                    geom, vel, dt, self.nu, uinf, tab3,
+                    lambda u, nudt: helm(
+                        u, nudt, tab_arg=tab1, flux_arg=ftab
+                    ),
                 ),
-                self._tab3,
+                self._tab3, self._tab1, self._ftab,
             )
         else:
             self._advdiff = jit_bound(
@@ -269,21 +293,6 @@ class AMRSimulation:
             self._vol, self._xc,
         )
         # ALL obstacles' force QoI in one (n_obs, 13) host read per step
-        self._forces = jit_bound(
-            lambda chis, p, vel, cms, ubodies, udefs, vunits, tab1, xc:
-            jnp.stack(
-                [
-                    pack_forces(
-                        amr_ops.force_integrals_blocks(
-                            geom, tab1, xc, c, p, vel, self.nu,
-                            cms[i], ubodies[i], udefs[i], vunits[i]
-                        )
-                    )
-                    for i, c in enumerate(chis)
-                ]
-            ),
-            self._tab1, self._xc,
-        )
         # per-obstacle rigid+deformation velocity field from the cached
         # device cell centers (avoids Obstacle.body_velocity_field's host
         # rebuild of cell_centers every step)
@@ -392,8 +401,7 @@ class AMRSimulation:
         blocking reads of pure latency.  This path pays ~1 dispatch and
         reads one pack, one step late, on a worker thread."""
         from cup3d_tpu.models.base import (
-            RIGID_PACK, pack_forces, pack_moments, rigid_update_device,
-            vel_unit_dev,
+            pack_forces, pack_moments, rigid_update_device,
         )
         from cup3d_tpu.models.collisions import overlap_count
 
@@ -413,17 +421,18 @@ class AMRSimulation:
         if cfg.implicitDiffusion:
             from cup3d_tpu.ops import diffusion as dif
 
-            # built once per layout with concrete tables (closure): the
-            # implicit branch keeps the compile-payload caveat of
-            # _rebuild's helm (tables-as-arguments covers the explicit path)
+            # the captured tables are fallbacks only: the traced tab1/ftab
+            # arguments flow through helm's tab_arg/flux_arg at call time
             helm = dif.build_amr_helmholtz_solver(
                 geom, tol_abs=cfg.diffusionTol, tol_rel=cfg.diffusionTolRel,
                 tab=self._tab1, flux_tab=self._ftab,
             )
 
-        def mega(vel, p, chis, udefs, rigid, forced, blocked, fixmask,
-                 uinf, dt, lam, tab1, tab3, ftab, xc, vol, profile,
-                 second_order):
+        h_fine = float(g.h0 / (1 << (len(g._slot_maps) - 1)))
+
+        def mega(vel, p, chis, udefs, sdfs, rigid, forced, blocked,
+                 fixmask, slots, b0s, uinf, dt, lam, tab1, tab3, ftab,
+                 xc, vol, profile, second_order):
             n_obs = chis.shape[0]
             chi = jnp.max(chis, axis=0)
             den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
@@ -433,7 +442,10 @@ class AMRSimulation:
                 from cup3d_tpu.ops import diffusion as dif
 
                 vel = dif.implicit_step_blocks(
-                    geom, vel, dt, nu, uinf, tab3, helm
+                    geom, vel, dt, nu, uinf, tab3,
+                    lambda u, nudt: helm(
+                        u, nudt, tab_arg=tab1, flux_arg=ftab
+                    ),
                 )
             else:
                 vel = amr_ops.rk3_step_blocks(
@@ -487,13 +499,18 @@ class AMRSimulation:
                 p_init=p, second_order=second_order,
             )
 
+            # surface-point probe per obstacle (ops/surface.py): the
+            # production force measure, on the obstacle's dense window
+            from cup3d_tpu.ops.surface import probe_blocks_core
+
             F = jnp.stack(
                 [
                     pack_forces(
-                        amr_ops.force_integrals_blocks(
-                            geom, tab1, xc, chis[i], p, vel, nu,
-                            cm_new[i], ub[i], udefs[i],
-                            vel_unit_dev(out[i, 0:3]),
+                        probe_blocks_core(
+                            vel, p, chis[i], sdfs[i], udefs[i],
+                            slots[i], b0s[i],
+                            jnp.asarray(h_fine, vel.dtype), nu,
+                            cm_new[i], out[i, 0:3], out[i, 3:6],
                         )
                     )
                     for i in range(n_obs)
@@ -571,15 +588,41 @@ class AMRSimulation:
             udefs.append(
                 udef if udef is not None else self.grid.zeros(3, self.dtype)
             )
-        chis, udefs, chi, udef = _combine_obstacle_fields(
-            jnp.stack(sdfs), jnp.stack(udefs), h_raw, combine
+        if self.forest is None:
+            chis, udefs, chi, udef = _combine_obstacle_fields(
+                jnp.stack(sdfs), jnp.stack(udefs), h_raw, combine=combine,
+                tab=self._tab1, bs=self.grid.bs,
+            )
+            for i, ob in enumerate(self.obstacles):
+                ob.chi = chis[i]
+                ob.udef = udefs[i]
+                # kept for the surface-point force probe (ops/surface.py)
+                ob.sdf = sdfs[i]
+            if combine:
+                self.state["chi"] = chi
+                self.state["udef"] = udef
+            return
+        # mesh mode: the Towers chi needs SDF halos, which live behind the
+        # sharded forest's exchange — pad first, assemble, then combine
+        # (same construction as the single-device path, so sharded-vs-
+        # single trajectories stay comparable)
+        from cup3d_tpu.ops.chi import towers_chi
+
+        chis_p, udefs_p = [], []
+        for ob, sdf, ud in zip(self.obstacles, sdfs, udefs):
+            sdf_p = self._pad(sdf)
+            lab = self._tab1.assemble_scalar(sdf_p, self.grid.bs)
+            chi_p = towers_chi(lab, self._h_col)
+            ud_p = self._pad(ud) * (chi_p > 0)[..., None]
+            ob.chi, ob.udef, ob.sdf = chi_p, ud_p, sdf_p
+            chis_p.append(chi_p)
+            udefs_p.append(ud_p)
+        stack = jnp.stack(chis_p)
+        self.state["chi"] = jnp.max(stack, axis=0)
+        den = jnp.maximum(jnp.sum(stack, axis=0), _EPS)[..., None]
+        self.state["udef"] = (
+            sum(c[..., None] * u for c, u in zip(chis_p, udefs_p)) / den
         )
-        for i, ob in enumerate(self.obstacles):
-            ob.chi = self._pad(chis[i])
-            ob.udef = self._pad(udefs[i])
-        if combine:
-            self.state["chi"] = self._pad(chi)
-            self.state["udef"] = self._pad(udef)
 
     def _obstacle_ubody(self, ob):
         # cached per (step, rigid state); penalization and the force pass
@@ -654,6 +697,26 @@ class AMRSimulation:
             from cup3d_tpu.utils.flows import taylor_green_2d
 
             vel = taylor_green_2d(self.grid, dtype=self.dtype)
+        elif self.cfg.initCond == "vorticity":
+            # coiled-vorticity IC (reference IC_vorticity,
+            # main.cpp:12506-12668): omega from the coil, then
+            # u_d = lap^-1(-(curl omega)_d) with the forest solver
+            from cup3d_tpu.utils.flows import coil_vorticity
+
+            g = self.grid
+            om = coil_vorticity(jnp.asarray(g.cell_centers(self.dtype)))
+            om = self._pad(om)
+            vlab = self._tab1.assemble_vector(om, g.bs)
+            curl = amr_ops.curl_blocks(self._geom, vlab, self._tab1.width)
+            comps = [
+                self._solver(
+                    -curl[..., d], tab_arg=self._tab1, flux_arg=self._ftab
+                )
+                for d in range(3)
+            ]
+            self.state["vel"] = jnp.stack(comps, axis=-1)
+            self.state["p"] = self._pad(self.grid.zeros(0, self.dtype))
+            return
         else:
             vel = self.grid.zeros(3, self.dtype)
         self.state["vel"] = self._pad(vel)
@@ -714,10 +777,10 @@ class AMRSimulation:
             prev_dt = self.dt
             dt_adv = cfl * hmin / max(umax, 1e-12)
             if cfg.pipelined and prev_dt > 0:
-                # max|u| may be up to two steps stale in pipelined mode:
-                # bounding dt growth keeps an accelerating flow inside the
-                # CFL limit until the fresher value lands (ADVICE r2)
-                dt_adv = min(dt_adv, 1.1 * prev_dt)
+                # max|u| may be ~2x the grouped-read cadence (~8 steps)
+                # stale in pipelined mode: 1.05^8 ~ 1.5 bounds the worst
+                # effective-CFL overshoot while fresher values land
+                dt_adv = min(dt_adv, 1.05 * prev_dt)
             if cfg.implicitDiffusion:
                 # keep the explicit cap while no velocity scale exists (see
                 # sim/simulation.py calc_max_timestep)
@@ -942,8 +1005,19 @@ class AMRSimulation:
             self.create_obstacles(dt, combine=False)
         with self.profiler("Megastep"):
             n = len(self.obstacles)
+            from cup3d_tpu.ops.surface import block_window_slots
+
             chis = jnp.stack([ob.chi for ob in self.obstacles])
             udefs = jnp.stack([ob.udef for ob in self.obstacles])
+            sdfs = jnp.stack([ob.sdf for ob in self.obstacles])
+            slots, b0s = [], []
+            for ob in self.obstacles:
+                s_, b0_, _ = block_window_slots(
+                    self.grid, np.asarray(ob.position), ob.length
+                )
+                slots.append(jnp.asarray(s_))
+                b0s.append(jnp.asarray(b0_, jnp.int32))
+            slots, b0s = tuple(slots), tuple(b0s)
             rigid = jnp.stack(
                 [ob.rigid_state_dev(self.dtype) for ob in self.obstacles]
             )
@@ -963,8 +1037,8 @@ class AMRSimulation:
                 else self.uinf_device()
             )
             vel, p, chi, udef, uinf_next, pack = self._megastep(
-                s["vel"], s["p"], chis, udefs, rigid, forced, blocked,
-                fixmask, uinf, dt_j,
+                s["vel"], s["p"], chis, udefs, sdfs, rigid, forced,
+                blocked, fixmask, slots, b0s, uinf, dt_j,
                 jnp.asarray(self.lambda_penal, self.dtype),
             )
             s["vel"], s["p"], s["chi"], s["udef"] = vel, p, chi, udef
@@ -1120,23 +1194,24 @@ class AMRSimulation:
         )
 
     def _compute_forces(self):
-        """Per-obstacle force/torque/power QoI (reference ComputeForces,
-        main.cpp:12496-12503, reduction 13079-13115)."""
+        """Per-obstacle force/torque/power QoI from the surface-point
+        probe (ops/surface.py; reference ComputeForces,
+        main.cpp:12250-12503)."""
+        from cup3d_tpu.ops.surface import force_integrals_probe_blocks
+
         s = self.state
-        cms = jnp.asarray(
-            np.stack([ob.centerOfMass for ob in self.obstacles]), self.dtype
-        )
-        vunits = jnp.asarray(
-            np.stack([vel_unit(ob.transVel) for ob in self.obstacles]),
-            self.dtype,
-        )
-        F = self._forces(
-            tuple(ob.chi for ob in self.obstacles), s["p"], s["vel"],
-            cms, tuple(self._obstacle_ubody(ob) for ob in self.obstacles),
-            tuple(ob.udef for ob in self.obstacles), vunits,
-        )
+        rows = [
+            pack_forces(
+                force_integrals_probe_blocks(
+                    self.grid, {"vel": s["vel"], "p": s["p"]}, ob.chi,
+                    ob.sdf, ob.udef, self.nu, ob.position, ob.length,
+                    ob.centerOfMass, ob.transVel, ob.angVel,
+                )
+            )
+            for ob in self.obstacles
+        ]
         # joins the end-of-step packed read (_consume_step_pack)
-        self._pending_parts.append(("forces", F.reshape(-1)))
+        self._pending_parts.append(("forces", jnp.stack(rows).reshape(-1)))
 
     def simulate(self):
         cfg = self.cfg

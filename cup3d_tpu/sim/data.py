@@ -41,6 +41,7 @@ class SimulationData:
             self.dtype,
             tol_abs=cfg.poissonTol,
             tol_rel=cfg.poissonTolRel,
+            mean_constraint=cfg.bMeanConstraint,
         )
 
         # scalars (host side, mirroring main.cpp:15348-15387 defaults)

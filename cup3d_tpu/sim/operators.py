@@ -205,6 +205,11 @@ def initial_conditions(sim: SimulationData) -> None:
 
         sim.state["vel"] = taylor_green_3d(grid, sim.dtype)
         return
+    if kind == "vorticity":
+        from cup3d_tpu.utils.flows import coil_velocity_uniform
+
+        sim.state["vel"] = coil_velocity_uniform(grid, sim.dtype)
+        return
     x = grid.cell_centers(sim.dtype)
     if kind == "channel":
         H = grid.extent[1]

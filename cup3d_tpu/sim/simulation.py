@@ -125,10 +125,10 @@ class Simulation:
             prev_dt = s.dt
             dt_adv = cfl * h / max(umax, 1e-12)
             if cfg.pipelined and prev_dt > 0:
-                # max|u| may be ~2x the grouped-read cadence stale in
-                # pipelined mode: bounding dt growth keeps an accelerating
-                # flow inside the CFL limit until a fresher value lands
-                dt_adv = min(dt_adv, 1.1 * prev_dt)
+                # max|u| may be ~2x the grouped-read cadence (~8 steps)
+                # stale in pipelined mode: 1.05^8 ~ 1.5 bounds the worst
+                # effective-CFL overshoot while fresher values land
+                dt_adv = min(dt_adv, 1.05 * prev_dt)
             if cfg.implicitDiffusion:
                 # a from-rest flow is diffusion-dominated: keep the explicit
                 # cap until any velocity scale exists, else dt_adv blows up
@@ -191,6 +191,11 @@ class Simulation:
                     self._pack_reader.emit(entry)
                 else:
                     self._consume_pack(entry)
+        elif self._pack_reader:
+            # a pack-less step (ADVICE r2: unreachable today in pipelined
+            # mode, but the coupling is fragile): keep draining so queued
+            # reads and the stale-umax chain still make progress
+            self._pack_reader.flush()
         s.step += 1
         s.time += dt
 

@@ -29,3 +29,48 @@ def taylor_green_3d(grid: UniformGrid, dtype=jnp.float32) -> jnp.ndarray:
     u = jnp.sin(k * x[..., 0]) * jnp.cos(k * x[..., 1]) * jnp.cos(k * x[..., 2])
     v = -jnp.cos(k * x[..., 0]) * jnp.sin(k * x[..., 1]) * jnp.cos(k * x[..., 2])
     return jnp.stack([u, v, jnp.zeros_like(u)], axis=-1)
+
+
+def coil_vorticity(xc: jnp.ndarray) -> jnp.ndarray:
+    """The reference's coiled-vorticity field (IC_vorticity,
+    main.cpp:12537-12614): a 90-point coil at radius R(phi) =
+    0.05 sin(2 phi) centered on (1,1,1); each cell takes the unit tangent
+    of the NEAREST coil point scaled by 1/(r^2+1)^2.  xc: (..., 3) cell
+    centers; returns omega (..., 3).  The absolute constants are the
+    reference's (meant for a domain enclosing (1,1,1))."""
+    ncoil, m = 90, 2
+    phi = np.arange(ncoil) * (2.0 * np.pi / ncoil)
+    R = 0.05 * np.sin(m * phi)
+    pts = np.stack(
+        [R * np.cos(phi) + 1.0, R * np.sin(phi) + 1.0,
+         R * np.cos(m * phi) + 1.0], axis=-1
+    )
+    dR = 0.05 * m * np.cos(m * phi)
+    tang = np.stack(
+        [dR * np.cos(phi) - R * np.sin(phi),
+         dR * np.sin(phi) + R * np.cos(phi),
+         dR * np.cos(m * phi) - m * R * np.sin(m * phi)], axis=-1
+    )
+    tang /= np.sqrt((tang**2).sum(-1) + 1e-21)[:, None]
+    p = jnp.asarray(pts, xc.dtype)
+    t = jnp.asarray(tang, xc.dtype)
+    d2 = jnp.sum((xc[..., None, :] - p) ** 2, axis=-1)  # (..., ncoil)
+    idx = jnp.argmin(d2, axis=-1)
+    r2 = jnp.take_along_axis(d2, idx[..., None], axis=-1)[..., 0]
+    mag = 1.0 / (r2 + 1.0) ** 2
+    return mag[..., None] * t[idx]
+
+
+def coil_velocity_uniform(grid: UniformGrid, dtype=jnp.float32):
+    """Velocity recovered from the coiled vorticity: u_d = lap^-1 of
+    -(curl omega)_d component-wise (the reference solves the same three
+    Poisson problems with its pressure solver, main.cpp:12614-12668).
+    Uses the exact spectral inverse on the uniform grid."""
+    from cup3d_tpu.ops import stencils as st
+    from cup3d_tpu.ops.poisson import build_spectral_solver
+
+    om = coil_vorticity(grid.cell_centers(dtype))
+    curl = st.curl(grid.pad_vector(om, 1), 1, grid.h)
+    solver = build_spectral_solver(grid, dtype)
+    comps = [solver(-curl[..., d]) for d in range(3)]
+    return jnp.stack(comps, axis=-1)

@@ -19,7 +19,10 @@ pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
 @pytest.fixture(scope="module")
 def fish_sim():
     cfg = SimulationConfig(
-        bpdx=1, bpdy=1, bpdz=1, levelMax=3, extent=1.0,
+        # levelMax=4 is the resolvable scale for an L=0.4 fish: with the
+        # reference's Towers chi a body thinner than the cell VANISHES
+        # (no positive-SDF cell -> chi = 0), exactly as in the reference
+        bpdx=1, bpdy=1, bpdz=1, levelMax=4, extent=1.0,
         BC_x="freespace", BC_y="freespace", BC_z="freespace",
         CFL=0.4, Rtol=5.0, Ctol=0.1, nu=1e-3, tend=0.0, nsteps=8,
         verbose=False, bMeanConstraint=2,
@@ -78,7 +81,10 @@ def test_divergence_gate(fish_sim):
     umax = float(sim._maxu(sim.state["vel"], sim.uinf_device()))
     assert umax < sim.cfg.uMax_allowed
     grad_scale = max(umax, 1e-12) / g.h.min()
-    assert d[fluid_blocks].max() < 0.1 * grad_scale
+    # measured today: div_fluid/grad_scale ~ 1e-4 on this config; the
+    # gate at 5e-4 fails if the coarse-fine band quality regresses by
+    # more than a few x (VERDICT r2 item 9 replaced the 0.1 sanity bound)
+    assert d[fluid_blocks].max() < 5e-4 * grad_scale
 
 
 def test_forces_logged(fish_sim, tmp_path_factory):

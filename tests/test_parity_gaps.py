@@ -1,0 +1,179 @@
+"""Round-3 parity closures: bMeanConstraint modes 0/1/3 (ComputeLHS,
+main.cpp:9273-9327), the coiled-vorticity initial condition
+(IC_vorticity, main.cpp:12506-12668), and mesh-aware checkpoint
+restore."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from cup3d_tpu.grid.blocks import BlockGrid
+from cup3d_tpu.grid.flux import build_flux_tables
+from cup3d_tpu.grid.octree import Octree, TreeConfig
+from cup3d_tpu.grid.uniform import BC, UniformGrid
+from cup3d_tpu.ops import amr_ops, krylov
+
+BS = 8
+
+
+def _two_level_grid():
+    t = Octree(TreeConfig((2, 2, 2), 2, (True,) * 3), 0)
+    t.refine((0, 0, 0, 0))
+    t.assert_balanced()
+    return BlockGrid(t, (1.0,) * 3, (BC.periodic,) * 3, bs=BS)
+
+
+@pytest.mark.parametrize("mode", [0, 1, 2, 3])
+def test_amr_mean_constraint_modes(mode):
+    """Every mode must solve the compatible Poisson problem to the same
+    GRADIENT (solutions differ by the nullspace constant only)."""
+    g = _two_level_grid()
+    rng = np.random.default_rng(0)
+    rhs = rng.standard_normal((g.nb, BS, BS, BS)).astype(np.float32)
+    vol = (g.h**3).reshape(g.nb, 1, 1, 1)
+    rhs -= (rhs * vol).sum() / (vol.sum() * BS**3)  # compatible
+    rhs_j = jnp.asarray(rhs)
+    ft = build_flux_tables(g)
+    tab = g.face_tables(1)
+
+    def solve(m):
+        s = amr_ops.build_amr_poisson_solver(
+            g, tab=tab, flux_tab=ft, tol_abs=1e-7, tol_rel=1e-5,
+            mean_constraint=m,
+        )
+        return np.asarray(s(rhs_j))
+
+    x = solve(mode)
+    # residual of the PLAIN Laplacian (the physical equation); modes 1/3
+    # REPLACE the corner-cell equation (reference ComputeLHS does the
+    # same), so that one cell is excluded from the check
+    r = np.asarray(
+        amr_ops.laplacian_blocks(g, jnp.asarray(x), tab, ft)
+    ) - rhs
+    if mode in (1, 3):
+        corner = int(
+            np.lexsort((g.ijk[:, 2], g.ijk[:, 1], g.ijk[:, 0]))[0]
+        )
+        r[corner, 0, 0, 0] = 0.0
+    b0 = np.sqrt((rhs**2).sum())
+    assert np.sqrt((r**2).sum()) < 5e-4 * b0, mode
+    # same field up to the nullspace constant (tolerance reflects the
+    # 1e-5 relative solve target through each operator's conditioning)
+    x2 = solve(2)
+    d = (x - x[0, 0, 0, 0]) - (x2 - x2[0, 0, 0, 0])
+    scale = np.abs(x2 - x2.mean()).max()
+    assert np.abs(d).max() < 5e-2 * scale + 1e-6, (mode, np.abs(d).max())
+
+
+@pytest.mark.parametrize("mode", [1, 3])
+def test_uniform_mean_constraint_modes(mode):
+    n = 32
+    grid = UniformGrid((n,) * 3, (1.0,) * 3, (BC.periodic,) * 3)
+    rng = np.random.default_rng(1)
+    rhs = rng.standard_normal((n,) * 3).astype(np.float32)
+    rhs -= rhs.mean()
+    rhs_j = jnp.asarray(rhs)
+    sm = krylov.build_iterative_solver(
+        grid, tol_abs=1e-7, tol_rel=1e-5, mean_constraint=mode
+    )
+    s2 = krylov.build_iterative_solver(
+        grid, tol_abs=1e-7, tol_rel=1e-5, mean_constraint=2
+    )
+    x = np.asarray(sm(rhs_j))
+    x2 = np.asarray(s2(rhs_j))
+    A = krylov.make_laplacian(grid)
+    r = np.asarray(A(jnp.asarray(x))) - rhs
+    r[0, 0, 0] = 0.0  # the pinned cell's equation is replaced (see AMR)
+    assert np.sqrt((r**2).sum()) < 5e-4 * np.sqrt((rhs**2).sum())
+    d = (x - x[0, 0, 0]) - (x2 - x2[0, 0, 0])
+    assert np.abs(d).max() < 5e-2 * np.abs(x2 - x2.mean()).max() + 1e-6
+
+
+def test_coil_vorticity_ic_uniform():
+    """The recovered velocity must be divergence-free-ish, nonzero, and
+    carry vorticity aligned with the target coil field."""
+    from cup3d_tpu.ops import diagnostics as diag
+    from cup3d_tpu.utils.flows import coil_velocity_uniform, coil_vorticity
+
+    n = 48
+    grid = UniformGrid((n,) * 3, (2.0,) * 3, (BC.periodic,) * 3)
+    vel = coil_velocity_uniform(grid)
+    assert np.isfinite(np.asarray(vel)).all()
+    assert float(jnp.max(jnp.abs(vel))) > 1e-3
+    _, div_max = diag.divergence_norms(grid, vel)
+    assert float(div_max) < 1e-2 * float(jnp.max(jnp.abs(vel))) / grid.h
+    om_target = np.asarray(coil_vorticity(grid.cell_centers(np.float32)))
+    om = np.asarray(diag.vorticity(grid, vel))
+    # the coil field is NOT solenoidal (nearest-point tangents), so the
+    # Biot-Savart recovery keeps only its divergence-free projection —
+    # the recovered vorticity correlates with, but does not equal, the
+    # target (the reference's construction has the same property)
+    a, b = om.reshape(-1), om_target.reshape(-1)
+    corr = (a @ b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-30)
+    assert corr > 0.5, corr
+
+
+def test_coil_vorticity_ic_amr_driver():
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    cfg = SimulationConfig(
+        bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=0, extent=2.0,
+        CFL=0.4, Rtol=0.5, Ctol=0.05, nu=1e-3, tend=0.0, nsteps=1,
+        rampup=0, dt=1e-3, poissonSolver="iterative", poissonTol=1e-6,
+        poissonTolRel=1e-4, initCond="vorticity", verbose=False,
+        freqDiagnostics=0,
+    )
+    sim = AMRSimulation(cfg)
+    sim.init()
+    v = np.asarray(sim.state["vel"])
+    assert np.isfinite(v).all() and np.abs(v).max() > 1e-4
+    sim.simulate()
+    assert np.isfinite(np.asarray(sim.state["vel"])).all()
+
+
+def test_sharded_checkpoint_restore(tmp_path):
+    """An AMR checkpoint saved from a single-device run restores INTO
+    mesh mode and continues with the single-device trajectory."""
+    import jax
+
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+    from cup3d_tpu.parallel.forest import make_block_mesh
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    cfg = SimulationConfig(
+        bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=0, extent=1.0,
+        CFL=0.4, Ctol=0.1, Rtol=5.0, nu=1e-3, tend=0.0, nsteps=2,
+        rampup=0, dt=1e-3, poissonSolver="iterative", poissonTol=1e-5,
+        poissonTolRel=1e-3,
+        factory_content="Sphere radius=0.14 xpos=0.4 ypos=0.5 zpos=0.5 "
+                        "xvel=0.3 bForcedInSimFrame=1",
+        verbose=False, freqDiagnostics=0,
+        path4serialization=str(tmp_path),
+    )
+    sim = AMRSimulation(cfg)
+    sim.init()
+    sim.simulate()
+    path = save_checkpoint(sim)
+
+    # continue single-device
+    ref = load_checkpoint(path)
+    ref.adapt_enabled = False
+    for _ in range(2):
+        ref.advance(1e-3)
+
+    # continue sharded on 8 virtual devices
+    mesh = make_block_mesh(jax.devices()[:8])
+    sh = load_checkpoint(path, mesh=mesh)
+    assert sh.forest is not None
+    assert sh.state["vel"].shape[0] == sh.forest.nb_pad
+    sh.adapt_enabled = False
+    for _ in range(2):
+        sh.advance(1e-3)
+    np.testing.assert_allclose(
+        np.asarray(sh.forest.unpad(sh.state["vel"])),
+        np.asarray(ref.state["vel"]), atol=5e-5,
+    )
+    for a, b in zip(sh.obstacles, ref.obstacles):
+        np.testing.assert_allclose(a.position, b.position, atol=1e-7)

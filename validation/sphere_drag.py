@@ -32,14 +32,14 @@ def schiller_naumann(re: float) -> float:
     return 24.0 / re * (1.0 + 0.15 * re**0.687)
 
 
-def run(re: float = 100.0, n: int = 128, tend_over_tstar: float = 6.0):
+def run(re: float = 100.0, n: int = 128, tend_over_tstar: float = 6.0,
+        D: float = 0.16):
     import jax.numpy as jnp
 
     from cup3d_tpu.config import SimulationConfig
     from cup3d_tpu.sim.simulation import Simulation
 
     U = 0.5
-    D = 0.16
     nu = U * D / re
     bpd = n // 8
     cfg = SimulationConfig(
@@ -89,6 +89,8 @@ def run(re: float = 100.0, n: int = 128, tend_over_tstar: float = 6.0):
         "Re": re,
         "n": n,
         "cells_per_D": D * n,
+        "D_over_L": D,
+        "measure": "surface-point probe (ops/surface.py)",
         "Cd_surface": round(cd_avg, 4),
         "Cd_penalization": round(cd_penal, 4),
         "Cd_ref_schiller_naumann": round(cd_ref, 4),
@@ -107,4 +109,5 @@ def run(re: float = 100.0, n: int = 128, tend_over_tstar: float = 6.0):
 if __name__ == "__main__":
     re = float(sys.argv[1]) if len(sys.argv) > 1 else 100.0
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-    run(re, n)
+    D = float(sys.argv[3]) if len(sys.argv) > 3 else 0.16
+    run(re, n, D=D)
