@@ -88,10 +88,16 @@ def _time_steps(advance, calc_dt, warmup: int, iters: int,
 
 def _time_steps_robust(advance, calc_dt, warmup: int, iters: int,
                        tag: str = "run"):
-    """Per-step walls -> (median, mean, max).  The tunneled TPU's
-    device->host reads sporadically stall 1-3 s regardless of cadence or
-    strategy (measured; pure transport noise) — the median is the
-    defensible per-step cost, the mean/max quantify the stall exposure."""
+    """Per-step walls -> (trimmed mean, mean, max).
+
+    Pipelined drivers are structurally bimodal (most steps are async
+    dispatches; one in read_every steps absorbs the grouped host read),
+    so the MEAN is the sustained per-step cost — the median would claim
+    the dispatch floor.  The tunneled TPU additionally stalls reads for
+    1-3 s sporadically regardless of cadence or strategy (measured; pure
+    transport noise), so the primary number trims the top 10% of samples:
+    the regular read cadence stays in, the transport outliers fall out.
+    The untrimmed mean and max quantify the stall exposure."""
     for _ in range(warmup):
         advance(calc_dt())
     walls = []
@@ -100,8 +106,9 @@ def _time_steps_robust(advance, calc_dt, warmup: int, iters: int,
             t0 = time.perf_counter()
             advance(calc_dt())
             walls.append(time.perf_counter() - t0)
-    w = np.asarray(walls)
-    return float(np.median(w)), float(w.mean()), float(w.max())
+    w = np.sort(np.asarray(walls))
+    keep = max(1, int(np.ceil(len(w) * 0.9)))
+    return float(w[:keep].mean()), float(w.mean()), float(w.max())
 
 
 def bench_fish_uniform(n_default: int = 128):
@@ -358,6 +365,8 @@ def bench_amr_tgv():
         rampup=0, Rtol=1.8, Ctol=0.05,  # refine only the vortex cores
         poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
         initCond="taylorGreen", verbose=False, freqDiagnostics=0,
+        # obstacle-free fused stepping (sim/amr.py advance_pipelined_free)
+        pipelined=True,
     )
     sim = AMRSimulation(cfg)
     sim.init()
@@ -365,14 +374,16 @@ def bench_amr_tgv():
     # mesh so the timed window has no re-layouts/recompiles
     sim.adapt_enabled = False
     iters = 10
+    # warmup crosses two grouped-read cycles so their one-time compiles
+    # stay out of the timed window
     med, mean, wmax = _time_steps_robust(
-        sim.advance, sim.calc_max_timestep, warmup=3, iters=iters,
+        sim.advance, sim.calc_max_timestep, warmup=10, iters=iters,
         tag="amr_tgv",
     )
     total, div_max = sim._divnorms(sim.state["vel"])
     nb = sim.grid.nb
     out = {
-        "wall_per_step_s": round(med, 4),
+        "wall_per_step_s": round(med, 4),  # trimmed mean (see _time_steps_robust)
         "wall_per_step_mean_s": round(mean, 4),
         "wall_per_step_max_s": round(wmax, 4),
         "cells_per_s": nb * sim.grid.bs**3 / med,
@@ -495,7 +506,7 @@ def bench_two_fish_amr():
     )
     nb = sim.grid.nb
     return {
-        "wall_per_step_s": round(med, 4),
+        "wall_per_step_s": round(med, 4),  # trimmed mean (see _time_steps_robust)
         "wall_per_step_mean_s": round(mean, 4),
         "wall_per_step_max_s": round(wmax, 4),
         "cells_per_s": nb * sim.grid.bs**3 / med,

@@ -22,7 +22,7 @@ import os
 import sys
 from typing import List, Optional
 
-from cup3d_tpu.config import parse_args, parse_config_file
+from cup3d_tpu.config import parse_args, parse_config_file, parse_factory
 
 
 def _expand_conf(argv: List[str]) -> List[str]:
@@ -46,7 +46,15 @@ def _expand_conf(argv: List[str]) -> List[str]:
 
 def build_driver(argv: List[str]):
     cfg = parse_args(_expand_conf(argv))
-    if cfg.levelMax > 1:
+    multi_obstacle = (
+        len(parse_factory(cfg.resolved_factory_content() or "")) > 1
+    )
+    # capability-based: levelMax>1 needs the forest; pipelined
+    # multi-obstacle runs also route to the forest driver (its vmapped
+    # device megastep handles many bodies; the uniform driver's fast
+    # path is single-obstacle) — at levelMax=1 the forest IS the
+    # uniform grid, just block-laid-out
+    if cfg.levelMax > 1 or (cfg.pipelined and multi_obstacle):
         from cup3d_tpu.sim.amr import AMRSimulation
 
         return AMRSimulation(cfg)

@@ -430,6 +430,36 @@ class AMRSimulation:
 
         h_fine = float(g.h0 / (1 << (len(g._slot_maps) - 1)))
 
+        def advdiff_stage(vel, uinf, dt, tab1, tab3, ftab):
+            """Advection-diffusion honoring cfg.implicitDiffusion — shared
+            by the obstacle and obstacle-free megasteps."""
+            if cfg.implicitDiffusion:
+                from cup3d_tpu.ops import diffusion as dif
+
+                return dif.implicit_step_blocks(
+                    geom, vel, dt, nu, uinf, tab3,
+                    lambda u, nudt: helm(
+                        u, nudt, tab_arg=tab1, flux_arg=ftab
+                    ),
+                )
+            return amr_ops.rk3_step_blocks(geom, vel, dt, nu, uinf, tab3,
+                                           ftab)
+
+        def forcing_stage(vel, uinf, dt, vol, profile):
+            """FixMassFlux / uMax_forced forcing — shared by both
+            megasteps.  Returns (vel, flux_msr (1,))."""
+            flux_msr = jnp.zeros(1, self.dtype)
+            if cfg.bFixMassFlux:
+                u_target = 2.0 / 3.0 * cfg.uMax_forced
+                u_msr = jnp.sum((vel[..., 0] + uinf[0]) * vol) / vol_total
+                vel = vel.at[..., 0].add((u_target - u_msr) * profile)
+                flux_msr = u_msr.reshape(1)
+            elif cfg.uMax_forced > 0:
+                H = g.extent[1]
+                accel = 8.0 * nu * cfg.uMax_forced / (H * H)
+                vel = vel.at[..., 0].add(accel * dt)
+            return vel, flux_msr
+
         def mega(vel, p, chis, udefs, sdfs, rigid, forced, blocked,
                  fixmask, slots, b0s, uinf, dt, lam, tab1, tab3, ftab,
                  xc, vol, profile, second_order):
@@ -438,19 +468,7 @@ class AMRSimulation:
             den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
             udef = jnp.sum(chis[..., None] * udefs, axis=0) / den
 
-            if cfg.implicitDiffusion:
-                from cup3d_tpu.ops import diffusion as dif
-
-                vel = dif.implicit_step_blocks(
-                    geom, vel, dt, nu, uinf, tab3,
-                    lambda u, nudt: helm(
-                        u, nudt, tab_arg=tab1, flux_arg=ftab
-                    ),
-                )
-            else:
-                vel = amr_ops.rk3_step_blocks(
-                    geom, vel, dt, nu, uinf, tab3, ftab
-                )
+            vel = advdiff_stage(vel, uinf, dt, tab1, tab3, ftab)
 
             # rigid update on device, all obstacles at once
             cms = rigid[:, 12:15]
@@ -483,16 +501,7 @@ class AMRSimulation:
                 dt, vol, xc, cm_new,
             )
 
-            flux_msr = jnp.zeros(1, self.dtype)
-            if cfg.bFixMassFlux:
-                u_target = 2.0 / 3.0 * cfg.uMax_forced
-                u_msr = jnp.sum((vel[..., 0] + uinf[0]) * vol) / vol_total
-                vel = vel.at[..., 0].add((u_target - u_msr) * profile)
-                flux_msr = u_msr.reshape(1)
-            elif cfg.uMax_forced > 0:
-                H = g.extent[1]
-                accel = 8.0 * nu * cfg.uMax_forced / (H * H)
-                vel = vel.at[..., 0].add(accel * dt)
+            vel, flux_msr = forcing_stage(vel, uinf, dt, vol, profile)
 
             vel, p = amr_ops.project_blocks(
                 geom, vel, dt, self._solver, tab1, ftab, chi, udef,
@@ -554,6 +563,26 @@ class AMRSimulation:
             j2 if self.step_idx >= self.cfg.step_2nd_start else j1
         )(*a, self._tab1, self._tab3, self._ftab, self._xc, self._vol,
           profile_arr)
+
+        # obstacle-free fused step (amr_tgv-style runs): advection +
+        # forcing + projection + max|u| in one dispatch, same pack scheme
+        def mega_free(vel, p, uinf, dt, tab1, tab3, ftab, vol, profile,
+                      second_order):
+            vel = advdiff_stage(vel, uinf, dt, tab1, tab3, ftab)
+            vel, flux_msr = forcing_stage(vel, uinf, dt, vol, profile)
+            vel, p = amr_ops.project_blocks(
+                geom, vel, dt, self._solver, tab1, ftab,
+                p_init=p, second_order=second_order,
+            )
+            umax = jnp.max(jnp.abs(vel + uinf)).reshape(1)
+            pack = jnp.concatenate([flux_msr, umax])
+            return vel, p, pack
+
+        jf1 = jax.jit(partial(mega_free, second_order=False))
+        jf2 = jax.jit(partial(mega_free, second_order=True))
+        self._megastep_free = lambda *a: (
+            jf2 if self.step_idx >= self.cfg.step_2nd_start else jf1
+        )(*a, self._tab1, self._tab3, self._ftab, self._vol, profile_arr)
 
     # -- obstacles ---------------------------------------------------------
 
@@ -732,16 +761,14 @@ class AMRSimulation:
                     "pipelined AMR mode is single-device (the sharded "
                     "forest keeps the per-operator path)"
                 )
-            if not self.obstacles:
-                raise ValueError("pipelined AMR mode requires obstacles")
             for ob in self.obstacles:
-                if (getattr(ob, "bCorrectPosition", False)
-                        or getattr(ob, "bCorrectPositionZ", False)
-                        or getattr(ob, "bCorrectRoll", False)):
+                # stale-PID allowed (see sim/simulation.py init); roll
+                # correction mutates the host rigid solve and is not
+                if getattr(ob, "bCorrectRoll", False):
                     raise ValueError(
-                        "pipelined mode is a throughput mode: PID/roll-"
-                        "corrected obstacles need current host mirrors "
-                        "every step — run without -pipelined"
+                        "pipelined mode cannot run roll-corrected "
+                        "obstacles (host-side angVel mutation) — run "
+                        "without -pipelined"
                     )
         self.create_obstacles()
         self._ic()
@@ -835,10 +862,11 @@ class AMRSimulation:
         if (
             self.cfg.pipelined
             and self.forest is None
-            and self.obstacles
             and not self._collision_hot
         ):
-            return self.advance_pipelined(dt)
+            if self.obstacles:
+                return self.advance_pipelined(dt)
+            return self.advance_pipelined_free(dt)
         if self._pack_reader:
             # entering the host path from pipelined mode (collision
             # fallback or mode switch): mirrors must be current and the
@@ -1094,6 +1122,60 @@ class AMRSimulation:
             self._pack_reader.emit(
                 {"layout": layout, "pack": pack, "time": self.time,
                  "step": self.step_idx}
+            )
+        self.step_idx += 1
+        self.time += dt
+
+    def advance_pipelined_free(self, dt: float):
+        """Obstacle-free fused stepping (the amr_tgv/TGV regime): one
+        dispatch per step, same grouped pack reads and scores prefetch."""
+        s = self.state
+        dt_j = jnp.asarray(dt, self.dtype)
+        self._maybe_dump_save()
+        if self.adapt_enabled and (
+            self.step_idx < 10 or self.step_idx % ADAPT_EVERY == 0
+        ):
+            with self.profiler("AdaptMesh"):
+                self.adapt_mesh()
+        with self.profiler("Megastep"):
+            uinf = (
+                self._uinf_dev
+                if self._uinf_dev is not None
+                else self.uinf_device()
+            )
+            vel, p, pack = self._megastep_free(s["vel"], s["p"], uinf, dt_j)
+            s["vel"], s["p"] = vel, p
+            nxt = self.step_idx + 1
+            if self.adapt_enabled and (nxt < 10 or nxt % ADAPT_EVERY == 0):
+                vort, near = self._scores(s["vel"], s["chi"])
+                packed = jnp.concatenate(
+                    [vort.astype(self.dtype), near.astype(self.dtype)]
+                )
+                try:
+                    packed.copy_to_host_async()
+                except Exception:
+                    pass
+                self._scores_prefetch = (packed, self.grid.nb)
+        freq = self.cfg.freqDiagnostics
+        if freq > 0 and self.step_idx % freq == 0:
+            with self.profiler("Diagnostics"):
+                total, peak = self._divnorms(s["vel"])
+                self.logger.write(
+                    "div.txt",
+                    f"{self.step_idx} {self.time:.8e} {float(total):.8e}"
+                    f" {float(peak):.8e}\n",
+                )
+                d = self._dissipation(s["vel"])
+                self.logger.write(
+                    "energy.txt",
+                    f"{self.time:.8e} {float(d['kinetic_energy']):.8e} "
+                    f"{float(d['enstrophy']):.8e}"
+                    f" {float(d['dissipation_rate']):.8e}\n",
+                )
+        with self.profiler("SyncQoI"):
+            self._pack_reader.emit(
+                {"layout": [("flux", 1), ("umax", 1)], "pack": pack,
+                 "time": self.time, "step": self.step_idx}
             )
         self.step_idx += 1
         self.time += dt

@@ -50,13 +50,17 @@ class Simulation:
                     "without -pipelined"
                 )
             for ob in self.sim.obstacles:
-                if (getattr(ob, "bCorrectPosition", False)
-                        or getattr(ob, "bCorrectPositionZ", False)
-                        or getattr(ob, "bCorrectRoll", False)):
+                # stale-PID: position/depth controllers read host mirrors
+                # that lag ~2x the grouped-read cadence; they are gentle,
+                # clipped controllers and tolerate the lag (tested in
+                # tests/test_amr_pipelined.py).  Roll correction instead
+                # MUTATES angVel right after the 6x6 solve on host and
+                # cannot ride the device rigid chain.
+                if getattr(ob, "bCorrectRoll", False):
                     raise ValueError(
-                        "pipelined mode is a throughput mode: PID/roll-"
-                        "corrected obstacles need current host mirrors "
-                        "every step — run without -pipelined"
+                        "pipelined mode cannot run roll-corrected "
+                        "obstacles (host-side angVel mutation) — run "
+                        "without -pipelined"
                     )
         ops.initial_conditions(self.sim)
 

@@ -83,15 +83,64 @@ def test_pipelined_two_fish_matches_host_path():
     assert np.linalg.norm(pipe.obstacles[0].transVel) > 0.0
 
 
-def test_pipelined_rejects_pid_fish():
+def test_pipelined_obstacle_free_matches_host():
+    """Obstacle-free fused stepping (advance_pipelined_free) reproduces
+    the host path on a mixed-level Taylor-Green run."""
+    def run(pipe):
+        cfg = SimulationConfig(
+            bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=0,
+            extent=float(2 * np.pi), CFL=0.4, Rtol=1.8, Ctol=0.05,
+            nu=1e-3, tend=0.0, nsteps=6, rampup=0, dt=1e-3,
+            poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+            initCond="taylorGreen", verbose=False, freqDiagnostics=0,
+            pipelined=pipe,
+        )
+        sim = AMRSimulation(cfg)
+        sim.init()
+        sim.adapt_enabled = False
+        sim.simulate()
+        return sim
+
+    pipe, ref = run(True), run(False)
+    np.testing.assert_allclose(
+        np.asarray(pipe.state["vel"]), np.asarray(ref.state["vel"]),
+        atol=2e-5,
+    )
+
+
+def test_pipelined_rejects_roll_corrected_fish():
+    """Roll correction mutates angVel on host right after the 6x6 solve —
+    incompatible with the device rigid chain."""
     with pytest.raises(ValueError):
         _run(
             True,
             factory=(
                 "StefanFish L=0.4 T=1.0 xpos=0.3 ypos=0.5 zpos=0.5 "
-                "heightProfile=danio widthProfile=stefan CorrectPosition=1"
+                "heightProfile=danio widthProfile=stefan CorrectRoll=1"
             ),
         )
+
+
+def test_pipelined_stale_pid_fish_runs():
+    """Position/depth PID fish run in pipelined mode on stale mirrors
+    (bounded by the grouped-read cadence) and track the host path."""
+    factory = (
+        "StefanFish L=0.4 T=1.0 xpos=0.3 ypos=0.5 zpos=0.5 "
+        "heightProfile=danio widthProfile=stefan CorrectPosition=1 "
+        "CorrectPositionZ=1"
+    )
+    # nsteps must exceed 2x the grouped-read cadence (4) so the PID
+    # actually consumes stale packs mid-run — the staleness under test
+    pipe = _run(True, nsteps=10, factory=factory, level_max=4, adapt=False)
+    ref = _run(False, nsteps=10, factory=factory, level_max=4, adapt=False)
+    assert pipe._pack_reader.read_every * 2 < 10
+    for ob in pipe.obstacles:
+        assert np.all(np.isfinite(ob.position))
+    # stale PID inputs lag by <= 2x the read cadence; the clipped, gentle
+    # controllers keep the trajectory close to the fresh-mirror host path
+    np.testing.assert_allclose(
+        pipe.obstacles[0].position, ref.obstacles[0].position, atol=1e-5
+    )
 
 
 def test_pipelined_collision_fallback():
