@@ -24,6 +24,13 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+# body-frame rotations are position-critical: at this JAX build's default
+# bf16-grade matmul precision the rotated coordinates carry ~1e-2 relative
+# error, which exceeds the SDF scale of a thin fish section (the sharp
+# Towers chi then loses every interior cell ON TPU while CPU runs are
+# fine) — every geometric einsum here pins HIGHEST precision
+_HI = jax.lax.Precision.HIGHEST
+
 _WEPS = 1e-10  # degenerate-section guard (reference: width,height >= 1e-10)
 
 
@@ -37,7 +44,7 @@ def _segment_distance(p, seg):
     a = seg["r1"] - seg["r0"]
     alen2 = jnp.maximum(jnp.dot(a, a), 1e-30)
     delta = p - seg["r0"]
-    t_raw = jnp.einsum("...c,c->...", delta, a) / alen2
+    t_raw = jnp.einsum("...c,c->...", delta, a, precision=_HI) / alen2
     t = jnp.clip(t_raw, 0.0, 1.0)
     # axial excess beyond the segment span, in physical length
     ax = (t_raw - t) * jnp.sqrt(alen2)
@@ -52,8 +59,8 @@ def _segment_distance(p, seg):
     hh = jnp.maximum(lerp(seg["h0"], seg["h1"]), _WEPS)
 
     d2 = p - rm
-    u = jnp.einsum("...c,...c->...", d2, nor)
-    v = jnp.einsum("...c,...c->...", d2, bn)
+    u = jnp.einsum("...c,...c->...", d2, nor, precision=_HI)
+    v = jnp.einsum("...c,...c->...", d2, bn, precision=_HI)
     q = jnp.sqrt((u / w) ** 2 + (v / hh) ** 2 + 1e-30)
     # first-order signed distance to the ellipse: f/|grad f| with f = q - 1.
     # |grad f| = hypot(u/w^2, v/h^2)/q is computed via the *unit* direction
@@ -112,7 +119,7 @@ def rasterize_points(points, midline, position, rot):
     """
     dtype = midline["r"].dtype
     # body frame: x_body = R^T (x_comp - position)
-    p = jnp.einsum("...c,cd->...d", points - position, rot)
+    p = jnp.einsum("...c,cd->...d", points - position, rot, precision=_HI)
     shape = p.shape[:-1]
 
     nm = midline["r"].shape[0]
@@ -138,7 +145,7 @@ def rasterize_points(points, midline, position, rot):
 
     dmin, udef_body = jax.lax.fori_loop(0, nm - 1, body, (d0, u0))
     sdf = -dmin  # reference convention: positive inside
-    udef_comp = jnp.einsum("...c,dc->...d", udef_body, rot)
+    udef_comp = jnp.einsum("...c,dc->...d", udef_body, rot, precision=_HI)
     return sdf, udef_comp
 
 

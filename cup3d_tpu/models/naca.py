@@ -19,7 +19,12 @@ surfaces with one polyline in the (x, |y|) half-plane.
 from __future__ import annotations
 
 import jax
+import jax
 import jax.numpy as jnp
+
+# position-critical rotation: default bf16-grade matmul precision corrupts
+# thin-section SDFs on TPU (see models/fish/rasterize.py)
+_HI = jax.lax.Precision.HIGHEST
 import numpy as np
 
 from cup3d_tpu.models.base import Obstacle
@@ -31,7 +36,7 @@ from cup3d_tpu.models.fish.shapes import naca_width
 def _naca_sdf(points, position, rot, xs, ws, half_height):
     """Signed distance (>0 inside) of computational-frame ``points`` to the
     extruded airfoil: min(signed 2-D profile distance, z-slab distance)."""
-    p = jnp.einsum("...c,cd->...d", points - position, rot)  # body frame
+    p = jnp.einsum("...c,cd->...d", points - position, rot, precision=_HI)  # body frame
     xb, yb, zb = p[..., 0], jnp.abs(p[..., 1]), p[..., 2]
 
     # inside test in the (x, |y|) half-plane: under the width graph

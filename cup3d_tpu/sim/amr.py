@@ -547,7 +547,10 @@ class AMRSimulation:
                 out[:, 0:3] * fixmask[:, None], axis=0
             ) / jnp.maximum(nfix, 1.0)
             uinf_next = jnp.where(nfix > 0, -mean_tv, uinf)
-            umax = jnp.max(jnp.abs(vel + uinf_next)).reshape(1)
+            umax = jnp.maximum(
+                jnp.max(jnp.abs(vel + uinf_next)),
+                jnp.max(jnp.abs(udef)),
+            ).reshape(1)
             pack = jnp.concatenate(
                 [out.reshape(-1), PF.reshape(-1).astype(self.dtype),
                  F.reshape(-1), overlaps, flux_msr, umax]
@@ -792,6 +795,13 @@ class AMRSimulation:
             # still be in flight); staleness is bounded by two steps
         else:
             umax = float(self._maxu(self.state["vel"], self.uinf_device()))
+            if self.obstacles:
+                # body kinematics bound the CFL immediately (see
+                # sim/simulation.py calc_max_timestep)
+                umax = max(
+                    umax,
+                    float(jnp.max(jnp.abs(self.state["udef"]))),
+                )
         if umax > cfg.uMax_allowed:
             self.logger.flush()
             raise RuntimeError(f"runaway velocity: max|u|={umax:.3g}")
@@ -802,11 +812,11 @@ class AMRSimulation:
             if self.step_idx < cfg.rampup:
                 cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - self.step_idx / cfg.rampup))
             prev_dt = self.dt
+            if cfg.pipelined:
+                # stale-umax margin: see sim/simulation.py calc_max_timestep
+                umax = 1.5 * umax
             dt_adv = cfl * hmin / max(umax, 1e-12)
             if cfg.pipelined and prev_dt > 0:
-                # max|u| may be ~2x the grouped-read cadence (~8 steps)
-                # stale in pipelined mode: 1.05^8 ~ 1.5 bounds the worst
-                # effective-CFL overshoot while fresher values land
                 dt_adv = min(dt_adv, 1.05 * prev_dt)
             if cfg.implicitDiffusion:
                 # keep the explicit cap while no velocity scale exists (see
@@ -1240,10 +1250,12 @@ class AMRSimulation:
 
         parts = self._pending_parts
         self._pending_parts = []
-        parts.append(
-            ("umax",
-             self._maxu(self.state["vel"], self.uinf_device()).reshape(1))
-        )
+        umax_dev = self._maxu(self.state["vel"], self.uinf_device())
+        if self.obstacles:
+            umax_dev = jnp.maximum(
+                umax_dev, jnp.max(jnp.abs(self.state["udef"]))
+            )
+        parts.append(("umax", umax_dev.reshape(1)))
         pack = jnp.concatenate([p[1].astype(self.dtype) for p in parts])
         vals = np.asarray(pack, np.float64)
         off = 0

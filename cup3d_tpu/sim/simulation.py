@@ -115,6 +115,17 @@ class Simulation:
             # bounded by ~2x the grouped-read cadence (sim/pack.py)
         else:
             umax = float(self._max_u(s.state["vel"], s.uinf_device()))
+            if s.obstacles:
+                # the CFL scale must see the BODY kinematics immediately:
+                # at full gait amplitude the tail's deformation velocity
+                # reaches the advective limit one step before it imprints
+                # on the measured fluid field (blow-up observed at the
+                # diffusive-cap dt otherwise)
+                import jax.numpy as _jnp
+
+                umax = max(
+                    umax, float(_jnp.max(_jnp.abs(s.state["udef"])))
+                )
         if umax > cfg.uMax_allowed:
             s.logger.flush()
             raise RuntimeError(
@@ -127,11 +138,16 @@ class Simulation:
             if s.step < cfg.rampup:  # logarithmic ramp 1e-2*CFL -> CFL
                 cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - s.step / cfg.rampup))
             prev_dt = s.dt
+            if cfg.pipelined:
+                # max|u| may be ~2x the grouped-read cadence (~8 steps)
+                # stale: assume it can have grown 1.5x since measured (the
+                # dt growth bound below limits it to 1.05^8 ~ 1.5) so the
+                # EFFECTIVE CFL never exceeds the configured value — a
+                # sharp-chi fish at full gait measurably blows up without
+                # this margin while the fresh-umax host path is stable
+                umax = 1.5 * umax
             dt_adv = cfl * h / max(umax, 1e-12)
             if cfg.pipelined and prev_dt > 0:
-                # max|u| may be ~2x the grouped-read cadence (~8 steps)
-                # stale in pipelined mode: 1.05^8 ~ 1.5 bounds the worst
-                # effective-CFL overshoot while fresher values land
                 dt_adv = min(dt_adv, 1.05 * prev_dt)
             if cfg.implicitDiffusion:
                 # a from-rest flow is diffusion-dominated: keep the explicit
@@ -214,10 +230,14 @@ class Simulation:
         s = self.sim
         parts = s.pending_parts
         s.pending_parts = []
-        parts.append(
-            ("umax",
-             self._max_u(s.state["vel"], s.uinf_device()).reshape(1))
-        )
+        umax_dev = self._max_u(s.state["vel"], s.uinf_device())
+        if s.obstacles:
+            # include body kinematics in the CFL scale (see
+            # calc_max_timestep)
+            umax_dev = jnp.maximum(
+                umax_dev, jnp.max(jnp.abs(s.state["udef"]))
+            )
+        parts.append(("umax", umax_dev.reshape(1)))
         # pack in the solver dtype: a forced f32 cast would silently
         # truncate the rigid trajectory in a float64 configuration
         pack = jnp.concatenate([p[1].astype(s.dtype) for p in parts])
