@@ -50,6 +50,14 @@ class SimulationConfig:
     # max|u| one step older than the reference's policy (CFL slack absorbs
     # it); requires a single obstacle without PID/roll corrections.
     pipelined: bool = False
+    # device-resident dt chain (round 4): in pipelined obstacle-free runs
+    # the CFL dt is computed ON DEVICE from the previous step's max|u|
+    # (exactly the non-pipelined one-step-lag policy, no staleness margin)
+    # and never read back — the steady-state step issues zero blocking
+    # transfers.  -1 = auto (on for TPU backends when eligible), 0 = off,
+    # 1 = force on (tests).  Obstacle runs keep the host dt: fish midline
+    # kinematics consume host time each step.
+    dtDevice: int = -1
 
     # -- fluid (main.cpp:15357-15363) --
     nu: float = 1e-3

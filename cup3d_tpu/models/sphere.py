@@ -18,6 +18,12 @@ class Sphere(Obstacle):
     def __init__(self, sim, spec):
         super().__init__(sim, spec)
         self.radius = float(spec.get("radius", self.length / 2))
+        # the force-probe window is sized from self.length
+        # (ops/surface.probe_margin): an explicit radius > length/2 would
+        # silently leave surface cells outside the window (dS=0, forces
+        # under-measured) — keep length consistent with the actual extent
+        # (ADVICE r3, medium)
+        self.length = max(self.length, 2.0 * self.radius)
 
     def rasterize(self, t: float):
         grid = self.sim.grid

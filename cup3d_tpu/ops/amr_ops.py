@@ -270,9 +270,9 @@ def build_amr_poisson_solver(
         return jnp.sum(x * vol) / vol_total
 
     def M(r):
-        # per-block CG with the block's own h^2 (poisson_kernels getZ,
+        # per-block getZ with the block's own h^2 (poisson_kernels getZ,
         # main.cpp:14617-14746); blocks are already bs^3 tiles
-        return krylov.block_cg_tiles(-h2 * r, precond_iters)
+        return krylov.getz_blocks(-h2 * r, cg_iters=precond_iters)
 
     def A_of(t, ft):
         if mean_constraint == 1:

@@ -236,8 +236,8 @@ def build_amr_helmholtz_solver(
                 )
 
             def M(r):
-                return krylov.block_cg_tiles(shift * r, precond_iters,
-                                             shift=shift)
+                return krylov.getz_blocks(shift * r, shift=shift,
+                                          cg_iters=precond_iters)
 
             # x0=b is a warm start: rel tolerance must reference the cold
             # RHS norm or the good start tightens the target and costs

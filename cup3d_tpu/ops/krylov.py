@@ -189,6 +189,39 @@ def _block_lap(t: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def use_exact_getz() -> bool:
+    """Round-4 default: the exact fast-diagonalization tile solve
+    (ops/tilesolve.py) replaces the fixed-sweep CG getZ.  CUP3D_GETZ=cg
+    restores the round-3 Pallas/jnp CG path."""
+    import os
+
+    return os.environ.get("CUP3D_GETZ", "") != "cg"
+
+
+def getz_blocks(b_scaled: jnp.ndarray, shift=None,
+                cg_iters: int = 24) -> jnp.ndarray:
+    """getZ preconditioner application in the (..., bs, bs, bs) blocks
+    layout: solve (-lap_tile + shift) z = b_scaled per tile.  Dispatches to
+    the exact tile solve (default) or the legacy fixed-iteration CG."""
+    from cup3d_tpu.ops import tilesolve
+
+    if use_exact_getz():
+        return tilesolve.tile_solve_blocks(b_scaled, shift)
+    return block_cg_tiles(b_scaled, cg_iters,
+                          shift=0.0 if shift is None else shift)
+
+
+def getz_lanes(bt_scaled: jnp.ndarray, shift=None,
+               cg_iters: int = 24) -> jnp.ndarray:
+    """getZ in the lane-resident (bs, bs, bs, T) layout (see getz_blocks)."""
+    from cup3d_tpu.ops import getz_pallas, tilesolve
+
+    if use_exact_getz():
+        return tilesolve.tile_solve_lanes(bt_scaled, shift)
+    return getz_pallas.cg_tiles_lanes(bt_scaled, cg_iters,
+                                      shift=0.0 if shift is None else shift)
+
+
 def block_cg_tiles(b: jnp.ndarray, iters: int, shift=0.0) -> jnp.ndarray:
     """Solve (-block_lap + shift*I) z = b independently on every
     trailing-bs^3 tile of ``b`` (shape (..., bs, bs, bs)) with `iters` CG
@@ -249,7 +282,7 @@ def make_block_cg_preconditioner(bs: int = 8, iters: int = 24,
     h2 = h * h
 
     def precond(r: jnp.ndarray) -> jnp.ndarray:
-        z = block_cg_tiles(-h2 * _tile(r, bs), iters)
+        z = getz_blocks(-h2 * _tile(r, bs), cg_iters=iters)
         return _untile(z)
 
     return precond
@@ -408,8 +441,6 @@ def build_iterative_solver(
     The solve runs in the lane-resident tile layout (to_lanes /
     make_laplacian_lanes): one transpose in, one out, none per iteration.
     """
-    from cup3d_tpu.ops.getz_pallas import cg_tiles_lanes
-
     if any(s % precond_bs for s in grid.shape):
         return _build_iterative_solver_dense(
             grid, tol_abs, tol_rel, maxiter, precond_bs, precond_iters,
@@ -428,7 +459,7 @@ def build_iterative_solver(
         A = A0
 
     def M(r):
-        return cg_tiles_lanes(-h2 * r, precond_iters)
+        return getz_lanes(-h2 * r, cg_iters=precond_iters)
 
     def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         if mean_constraint == 2:

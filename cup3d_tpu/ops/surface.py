@@ -129,6 +129,20 @@ def surface_force_window(
         )
         return geo & _gather(validf, ix, iy, iz, shape)
 
+    def nbhd_ok(ix, iy, iz):
+        """Probe-candidate acceptance: the cell AND its +-1 neighborhood
+        must be in-window — the reference rejects marching candidates
+        unless ix+dxi+-1 is inside the lab (guarding the centered second
+        derivatives); with slot=-1 holes in the AMR window the clamped
+        gathers would otherwise silently duplicate edge values (ADVICE r3)."""
+        ok = inwin(ix, iy, iz)
+        for a in range(3):
+            o = [ix, iy, iz]
+            for s in (-1, 1):
+                o[a] = (ix, iy, iz)[a] + s
+                ok = ok & inwin(*o)
+        return ok
+
     # -- probe point: march outward to the first chi < 0.01 cell ----------
     px, py, pz = base
     found = jnp.zeros(shape, bool)
@@ -136,7 +150,7 @@ def surface_force_window(
         cx = base[0] + jnp.round(k * nhat[..., 0]).astype(jnp.int32)
         cy = base[1] + jnp.round(k * nhat[..., 1]).astype(jnp.int32)
         cz = base[2] + jnp.round(k * nhat[..., 2]).astype(jnp.int32)
-        ok = inwin(cx, cy, cz) & ~found
+        ok = nbhd_ok(cx, cy, cz) & ~found
         px = jnp.where(ok, cx, px)
         py = jnp.where(ok, cy, py)
         pz = jnp.where(ok, cz, pz)
@@ -168,13 +182,26 @@ def surface_force_window(
         d6 = s[..., None] * sum(c * vk for c, vk in zip(_C6, v))
         d3 = s[..., None] * (-1.5 * v[0] + 2.0 * v[1] - 0.5 * v[2])
         d2 = s[..., None] * (v[1] - v[0])
-        ok5 = inwin(*at(5))[..., None]
-        ok2 = inwin(*at(2))[..., None]
+        # every intermediate sample must be valid, not just the endpoint:
+        # an AMR-window hole (slot=-1) between probe and endpoint would be
+        # zero-filled while the endpoint check passes (ADVICE r3)
+        oks = [inwin(*at(k)) for k in range(6)]
+        ok5 = (oks[1] & oks[2] & oks[3] & oks[4] & oks[5])[..., None]
+        ok2 = (oks[1] & oks[2])[..., None]
+        # final 2-pt fallback still reads at(1): zero the derivative when
+        # even that neighbor is a hole (code-review r4)
+        d2 = jnp.where(oks[1][..., None], d2, 0.0)
         return jnp.where(ok5, d6, jnp.where(ok2, d3, d2))
 
     dvdx = one_sided(0, sx)
     dvdy = one_sided(1, sy)
     dvdz = one_sided(2, sz)
+
+    # when no marching candidate passed nbhd_ok the probe stays at base
+    # with NO neighborhood guarantee: gate every centered/compact stencil
+    # below so holes demote to a zero (lower-order) contribution instead of
+    # reading clamped/zero-filled cells (code-review r4)
+    probe_ok = nbhd_ok(px, py, pz)
 
     def second(axis):
         o = [px, py, pz]
@@ -182,7 +209,8 @@ def surface_force_window(
         o = list(o)
         o[axis] = o[axis] + 1
         o2[axis] = o2[axis] - 1
-        return vat(*o) - 2.0 * vat(px, py, pz) + vat(*o2)
+        d2 = vat(*o) - 2.0 * vat(px, py, pz) + vat(*o2)
+        return jnp.where(probe_ok[..., None], d2, 0.0)
 
     d2x, d2y, d2z = second(0), second(1), second(2)
 
@@ -210,8 +238,19 @@ def surface_force_window(
             (vat(*at(1, 1)) - vat(*at(1, 0)))
             - (vat(*at(0, 1)) - vat(*at(0, 0)))
         )
-        ok = (inwin(*at(2, 0)) & inwin(*at(0, 2)))[..., None]
-        return jnp.where(ok, full, compact)
+        # all nine samples of the nested form must be valid (ADVICE r3:
+        # intermediate AMR-window holes must demote to the compact form);
+        # the compact 2x2 form's own samples (incl. the diagonal, which
+        # nbhd_ok never covers) must be valid too, else the mixed term
+        # drops to zero (code-review r4)
+        ok = jnp.ones(shape, bool)
+        for k1 in range(3):
+            for k2 in range(3):
+                ok = ok & inwin(*at(k1, k2))
+        okc = (inwin(*at(0, 0)) & inwin(*at(0, 1)) & inwin(*at(1, 0))
+               & inwin(*at(1, 1)))
+        compact = jnp.where(okc[..., None], compact, 0.0)
+        return jnp.where(ok[..., None], full, compact)
 
     dxy = mixed(0, sx, 1, sy)
     dxz = mixed(0, sx, 2, sz)

@@ -343,6 +343,21 @@ class ShardedForest:
             self._lab_cache[width] = self._build_lab(width)
         return self._lab_cache[width]
 
+    def face_tables(self, width: int):
+        """Sharded face-slab fast path (parallel/faces.py) — the round-3
+        FaceTables design under shard_map.  Falls back to the per-ghost
+        lab tables when the topology has degenerate closed-boundary blocks
+        (empty on periodic domains)."""
+        key = ("face", width)
+        if key not in self._lab_cache:
+            from cup3d_tpu.parallel.faces import build_sharded_face_tables
+
+            try:
+                self._lab_cache[key] = build_sharded_face_tables(self, width)
+            except ValueError:
+                self._lab_cache[key] = self.lab_tables(width)
+        return self._lab_cache[key]
+
     def _build_lab(self, width: int) -> ShardedLabTables:
         g = self.grid
         t = g.lab_tables(width)
@@ -458,11 +473,13 @@ class ShardedForest:
         with the forest's duck-typed tables, padded-aware volume weights,
         and a padding mask; halo exchange + refluxing ride the forest's
         collectives and the Krylov dots lower to psum over the mesh (the
-        reference's overlapped MPI_Iallreduce, main.cpp:14486-14550)."""
+        reference's overlapped MPI_Iallreduce, main.cpp:14486-14550).
+        Round 4: the halo assembly inside the Krylov loop runs on the
+        sharded face-slab fast path (parallel/faces.py)."""
         from cup3d_tpu.ops import amr_ops
 
         return amr_ops.build_amr_poisson_solver(
-            self.geom, tab=self.lab_tables(1), flux_tab=self.flux_tables,
+            self.geom, tab=self.face_tables(1), flux_tab=self.flux_tables,
             vol=self.vol, pmask=self.pmask, **kw,
         )
 
@@ -472,6 +489,6 @@ class ShardedForest:
         from cup3d_tpu.ops.diffusion import build_amr_helmholtz_solver
 
         return build_amr_helmholtz_solver(
-            self.geom, tab=self.lab_tables(1), flux_tab=self.flux_tables,
+            self.geom, tab=self.face_tables(1), flux_tab=self.flux_tables,
             **kw,
         )

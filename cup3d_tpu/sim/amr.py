@@ -58,6 +58,20 @@ ADAPT_EVERY = 20  # reference cadence (main.cpp:15314)
 _EPS = 1e-6
 
 
+@jax.jit
+def _dt_device_update(umax, cfl, hmin, dif_cap):
+    """dt = min(CFL h/|u|max, diffusive cap), all on device."""
+    return jnp.minimum(cfl * hmin / jnp.maximum(umax, 1e-12), dif_cap)
+
+
+@jax.jit
+def _dt_device_update_implicit(umax, cfl, hmin, dif_cap, floor_u):
+    """Implicit diffusion: the explicit cap applies only while no velocity
+    scale exists (sim/simulation.py calc_max_timestep)."""
+    cap = jnp.where(jnp.maximum(umax, floor_u) > 1e-8, jnp.inf, dif_cap)
+    return jnp.minimum(cfl * hmin / jnp.maximum(umax, 1e-12), cap)
+
+
 @partial(jax.jit, static_argnames=("combine", "bs"))
 def _combine_obstacle_fields(sdfs, udefs, h_raw, combine=True, tab=None,
                              bs=8):
@@ -130,6 +144,9 @@ class AMRSimulation:
         # the tunneled TPU; same scheme as sim/simulation.py)
         self._pending_parts: List = []
         self._umax_next = None
+        # device-resident max|u| scalar (the dt chain's CFL scale; see
+        # _use_device_dt) — sliced from the megastep pack, never fetched
+        self._umax_dev = None
         # static-AMR mode: freeze the (converged) mesh — no tagging, no
         # re-layout, no recompiles (BASELINE config #3 is a static 2-level
         # run; dynamic runs leave this True)
@@ -189,8 +206,11 @@ class AMRSimulation:
 
             self.forest = ShardedForest(g, self.mesh)
             geom = self.forest.geom
-            self._tab1 = self.forest.lab_tables(1)
-            self._tab3 = self.forest.lab_tables(3)
+            # round 4: mesh mode runs the face-slab fast path too
+            # (parallel/faces.py; falls back to per-ghost lab tables only
+            # on degenerate closed-boundary topologies)
+            self._tab1 = self.forest.face_tables(1)
+            self._tab3 = self.forest.face_tables(3)
             self._ftab = self.forest.flux_tables
             self._solver = self.forest.build_poisson_solver(
                 tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel,
@@ -339,7 +359,7 @@ class AMRSimulation:
             self._tab1,
         )
 
-        if cfg.pipelined and self.forest is None:
+        if cfg.pipelined:
             self._build_megastep(geom)
 
         self._moments = jit_bound(
@@ -559,13 +579,32 @@ class AMRSimulation:
 
         # tables AND field-sized geometry (cell centers, volumes, forcing
         # profile) travel as jit ARGUMENTS, not closure constants — the
-        # compile-payload rule of _rebuild applies here too
-        j1 = jax.jit(partial(mega, second_order=False))
-        j2 = jax.jit(partial(mega, second_order=True))
-        self._megastep = lambda *a: (
-            j2 if self.step_idx >= self.cfg.step_2nd_start else j1
-        )(*a, self._tab1, self._tab3, self._ftab, self._xc, self._vol,
-          profile_arr)
+        # compile-payload rule of _rebuild applies here too.  The sharded
+        # forest's duck-typed tables are NOT pytrees, so the mesh path
+        # keeps the closure style (its per-shard scale is bounded).
+        def order_dispatch(fn, tabs):
+            """jit fn once per pressure order; pick by step index at call
+            time.  Forest mode closes over the (non-pytree) tables;
+            single-device passes them as traced call args."""
+            if self.forest is not None:
+                jits = [
+                    jax.jit(lambda *a, _so=so: fn(*a, *tabs,
+                                                  second_order=_so))
+                    for so in (False, True)
+                ]
+                return lambda *a: jits[
+                    self.step_idx >= self.cfg.step_2nd_start
+                ](*a)
+            jits = [jax.jit(partial(fn, second_order=so))
+                    for so in (False, True)]
+            return lambda *a: jits[
+                self.step_idx >= self.cfg.step_2nd_start
+            ](*a, *tabs)
+
+        self._megastep = order_dispatch(
+            mega, (self._tab1, self._tab3, self._ftab, self._xc,
+                   self._vol, profile_arr),
+        )
 
         # obstacle-free fused step (amr_tgv-style runs): advection +
         # forcing + projection + max|u| in one dispatch, same pack scheme
@@ -581,11 +620,10 @@ class AMRSimulation:
             pack = jnp.concatenate([flux_msr, umax])
             return vel, p, pack
 
-        jf1 = jax.jit(partial(mega_free, second_order=False))
-        jf2 = jax.jit(partial(mega_free, second_order=True))
-        self._megastep_free = lambda *a: (
-            jf2 if self.step_idx >= self.cfg.step_2nd_start else jf1
-        )(*a, self._tab1, self._tab3, self._ftab, self._vol, profile_arr)
+        self._megastep_free = order_dispatch(
+            mega_free, (self._tab1, self._tab3, self._ftab, self._vol,
+                        profile_arr),
+        )
 
     # -- obstacles ---------------------------------------------------------
 
@@ -649,6 +687,8 @@ class AMRSimulation:
             ob.chi, ob.udef, ob.sdf = chi_p, ud_p, sdf_p
             chis_p.append(chi_p)
             udefs_p.append(ud_p)
+        if not combine:
+            return  # pipelined megastep recombines on device
         stack = jnp.stack(chis_p)
         self.state["chi"] = jnp.max(stack, axis=0)
         den = jnp.maximum(jnp.sum(stack, axis=0), _EPS)[..., None]
@@ -759,11 +799,6 @@ class AMRSimulation:
         rounds to converge the initial grid (main.cpp:15163-15178)."""
         self._add_obstacles()
         if self.cfg.pipelined:
-            if self.mesh is not None:
-                raise ValueError(
-                    "pipelined AMR mode is single-device (the sharded "
-                    "forest keeps the per-operator path)"
-                )
             for ob in self.obstacles:
                 # stale-PID allowed (see sim/simulation.py init); roll
                 # correction mutates the host rigid solve and is not
@@ -784,8 +819,63 @@ class AMRSimulation:
 
     # -- stepping ----------------------------------------------------------
 
+    def _use_device_dt(self) -> bool:
+        """Device-resident dt chain (VERDICT r3 item 4): eligible for
+        pipelined OBSTACLE-FREE runs (fish midline kinematics consume host
+        time each step) terminated by step count, with no time-based dump
+        cadence or mass-flux log rows that would force host reads."""
+        cfg = self.cfg
+        if not (cfg.pipelined and not self.obstacles and self.forest is None):
+            return False
+        if cfg.dt > 0 or cfg.tend > 0 or cfg.tdump > 0 or cfg.bFixMassFlux:
+            return False
+        if cfg.dtDevice == 0:
+            return False
+        return cfg.dtDevice == 1 or jax.default_backend() == "tpu"
+
+    def _calc_dt_device(self):
+        """CFL dt from the previous step's ON-DEVICE max|u| — exactly the
+        non-pipelined one-step-lag policy (no staleness margin, no growth
+        cap), with zero host transfers.  The runaway abort checks the
+        freshest host MIRROR (stale by <= ~3*read_every steps — an abort
+        tolerates lag; the dt itself never does)."""
+        cfg = self.cfg
+        um = self._umax_next
+        if um is not None and (not np.isfinite(um) or um > cfg.uMax_allowed):
+            self.logger.flush()
+            raise RuntimeError(f"runaway velocity: max|u|={um:.3g}")
+        if self._umax_dev is None:
+            self._umax_dev = self._maxu(self.state["vel"], self.uinf_device())
+        cfl = cfg.CFL
+        if self.step_idx < cfg.rampup:
+            cfl = cfg.CFL * 10.0 ** (
+                -2.0 * (1.0 - self.step_idx / cfg.rampup)
+            )
+        hmin = float(self.grid.h.min())
+        if cfg.implicitDiffusion:
+            # host policy: diffusive cap only while no velocity scale
+            floor_u = max(cfg.uMax_forced, float(np.abs(self.uinf).max()))
+            dt = _dt_device_update_implicit(
+                self._umax_dev, jnp.asarray(cfl, self.dtype),
+                jnp.asarray(hmin, self.dtype),
+                jnp.asarray(0.25 * hmin * hmin / self.nu, self.dtype),
+                jnp.asarray(floor_u, self.dtype),
+            )
+        else:
+            dt = _dt_device_update(
+                self._umax_dev, jnp.asarray(cfl, self.dtype),
+                jnp.asarray(hmin, self.dtype),
+                jnp.asarray(0.25 * hmin * hmin / self.nu, self.dtype),
+            )
+        self.dt = dt
+        if cfg.DLM > 0:
+            self.lambda_penal = cfg.DLM / dt
+        return dt
+
     def calc_max_timestep(self) -> float:
         cfg = self.cfg
+        if self._use_device_dt():
+            return self._calc_dt_device()
         hmin = float(self.grid.h.min())
         if self._umax_next is not None:
             umax = self._umax_next
@@ -817,7 +907,7 @@ class AMRSimulation:
                 umax = 1.5 * umax
             dt_adv = cfl * hmin / max(umax, 1e-12)
             if cfg.pipelined and prev_dt > 0:
-                dt_adv = min(dt_adv, 1.05 * prev_dt)
+                dt_adv = min(dt_adv, 1.03 * prev_dt)
             if cfg.implicitDiffusion:
                 # keep the explicit cap while no velocity scale exists (see
                 # sim/simulation.py calc_max_timestep)
@@ -869,11 +959,7 @@ class AMRSimulation:
                 dmp.dump_fields(prefix, self.time, self.grid, fields)
 
     def advance(self, dt: float):
-        if (
-            self.cfg.pipelined
-            and self.forest is None
-            and not self._collision_hot
-        ):
+        if self.cfg.pipelined and not self._collision_hot:
             if self.obstacles:
                 return self.advance_pipelined(dt)
             return self.advance_pipelined_free(dt)
@@ -1133,8 +1219,28 @@ class AMRSimulation:
                 {"layout": layout, "pack": pack, "time": self.time,
                  "step": self.step_idx}
             )
+            # collision staleness guard (ADVICE r3): the overlap pre-check
+            # in the pack is consumed up to ~2*read_every steps late.  When
+            # the (stale) host mirrors show two bodies' bounding boxes
+            # within a few fine cells of contact, kick an immediate read so
+            # _collision_hot latches with ~1-step staleness instead.
+            if n > 1 and self._mirrors_near_contact():
+                self._pack_reader.kick()
         self.step_idx += 1
         self.time += dt
+
+    def _mirrors_near_contact(self, margin_cells: float = 6.0) -> bool:
+        h_fine = float(self.grid.h.min())
+        obs = self.obstacles
+        for i in range(len(obs)):
+            for j in range(i + 1, len(obs)):
+                half = 0.5 * (obs[i].length + obs[j].length)
+                d = np.abs(
+                    np.asarray(obs[i].position) - np.asarray(obs[j].position)
+                )
+                if np.all(d < half + margin_cells * h_fine):
+                    return True
+        return False
 
     def advance_pipelined_free(self, dt: float):
         """Obstacle-free fused stepping (the amr_tgv/TGV regime): one
@@ -1155,6 +1261,8 @@ class AMRSimulation:
             )
             vel, p, pack = self._megastep_free(s["vel"], s["p"], uinf, dt_j)
             s["vel"], s["p"] = vel, p
+            # device dt chain: next step's CFL scale, never read back
+            self._umax_dev = pack[-1]
             nxt = self.step_idx + 1
             if self.adapt_enabled and (nxt < 10 or nxt % ADAPT_EVERY == 0):
                 vort, near = self._scores(s["vel"], s["chi"])

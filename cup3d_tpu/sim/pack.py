@@ -5,12 +5,23 @@ sporadically stall for seconds regardless of cadence, and concurrent reads
 serialize — so reading one QoI pack per step caps throughput at one
 latency per step.  Both drivers instead emit per-step packs into this
 reader, which every ``read_every`` steps concatenates them ON DEVICE into
-one vector, fetches it on a worker thread (at most one read in flight),
-and applies the entries strictly FIFO via the driver's consume callback.
+one vector and fetches it on a worker thread.  Entries are applied
+strictly FIFO via the driver's consume callback, ON THE MAIN THREAD, as
+their reads complete.
 
-Host-mirror staleness is bounded by ~2*read_every steps; the drivers'
-dt-growth bound and runaway abort guard stability against the stale
-max|u| (sim/simulation.py calc_max_timestep, sim/amr.py ditto).
+Round-4 change (VERDICT r3 item 4): ``emit`` never blocks on an in-flight
+read.  The old scheme joined the previous group's fetch before starting
+the next one, so every ``read_every`` steps the main thread stalled for a
+full tunnel latency (and any sporadic multi-second transport stall landed
+on the critical path).  Now completed reads are *polled* opportunistically
+at each emit and only ``max_inflight`` groups may be outstanding before
+emit applies blocking backpressure — a stalled read overlaps stepping
+instead of gating it.
+
+Host-mirror staleness is bounded by ~(1 + max_inflight) * read_every
+steps; the drivers' device-resident dt chain (or, on the host-dt path,
+their dt-growth bound and runaway abort) guards stability against the
+stale max|u| (sim/simulation.py calc_max_timestep, sim/amr.py ditto).
 """
 
 from __future__ import annotations
@@ -26,9 +37,11 @@ class GroupedPackReader:
     (name, size) pairs; ``consume(entry)`` is called with ``entry['vals']``
     filled, in emission order."""
 
-    def __init__(self, consume: Callable[[dict], None], read_every: int = 4):
+    def __init__(self, consume: Callable[[dict], None], read_every: int = 4,
+                 max_inflight: int = 2):
         self.consume = consume
         self.read_every = read_every
+        self.max_inflight = max_inflight
         self.queue: List[dict] = []
         self._readers: List = []
 
@@ -36,21 +49,36 @@ class GroupedPackReader:
         return bool(self.queue or self._readers)
 
     def emit(self, entry: dict) -> None:
+        self.queue.append(entry)
+        self.poll()
+        if len(self.queue) >= self.read_every:
+            while len(self._readers) >= self.max_inflight:
+                self._join_one()  # backpressure: bounded staleness/backlog
+            self.kick()
+
+    def kick(self) -> None:
+        """Start a worker-thread read of everything queued NOW, without
+        waiting for it.  Called by emit() at the regular cadence, and by
+        drivers that need fresher mirrors than the cadence provides (e.g.
+        the collision pre-check when obstacles approach contact).  An
+        opportunistic kick at the max_inflight limit is skipped — emit()'s
+        blocking backpressure is the only place allowed to wait, so the
+        reader count (and the retained device batches) stay bounded even
+        when a driver kicks every step through a transport stall."""
         import jax.numpy as jnp
 
-        self.queue.append(entry)
-        if len(self.queue) >= self.read_every:
-            group, self.queue = self.queue, []
-            batch = jnp.concatenate([e["pack"] for e in group])
-            try:
-                batch.copy_to_host_async()
-            except Exception:
-                pass
-            self.join()  # at most one group read in flight
-            holder = {"batch": batch, "group": group}
-            th = threading.Thread(target=self._fetch, args=(holder,))
-            th.start()
-            self._readers.append((th, holder))
+        if not self.queue or len(self._readers) >= self.max_inflight:
+            return
+        group, self.queue = self.queue, []
+        batch = jnp.concatenate([e["pack"] for e in group])
+        try:
+            batch.copy_to_host_async()
+        except Exception:
+            pass
+        holder = {"batch": batch, "group": group}
+        th = threading.Thread(target=self._fetch, args=(holder,))
+        th.start()
+        self._readers.append((th, holder))
 
     @staticmethod
     def _fetch(holder: dict) -> None:
@@ -59,20 +87,32 @@ class GroupedPackReader:
         except BaseException as e:  # re-raised on the main thread at join
             holder["err"] = e
 
+    def _consume_holder(self, holder: dict) -> None:
+        if "err" in holder:
+            raise holder["err"]
+        vals = holder["vals"]
+        off = 0
+        for entry in holder["group"]:
+            size = sum(s for _, s in entry["layout"])
+            entry["vals"] = vals[off:off + size]
+            off += size
+            self.consume(entry)
+
+    def _join_one(self) -> None:
+        th, holder = self._readers.pop(0)
+        th.join()
+        self._consume_holder(holder)
+
+    def poll(self) -> None:
+        """Consume completed reads without blocking (strictly FIFO: stop at
+        the first still-running fetch)."""
+        while self._readers and not self._readers[0][0].is_alive():
+            self._join_one()
+
     def join(self) -> None:
-        """Join in-flight group reads and consume their entries."""
+        """Join ALL in-flight group reads and consume their entries."""
         while self._readers:
-            th, holder = self._readers.pop(0)
-            th.join()
-            if "err" in holder:
-                raise holder["err"]
-            vals = holder["vals"]
-            off = 0
-            for entry in holder["group"]:
-                size = sum(s for _, s in entry["layout"])
-                entry["vals"] = vals[off:off + size]
-                off += size
-                self.consume(entry)
+            self._join_one()
 
     def flush(self) -> None:
         """Drain everything: in-flight reads, then still-queued packs."""

@@ -139,16 +139,17 @@ class Simulation:
                 cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - s.step / cfg.rampup))
             prev_dt = s.dt
             if cfg.pipelined:
-                # max|u| may be ~2x the grouped-read cadence (~8 steps)
-                # stale: assume it can have grown 1.5x since measured (the
-                # dt growth bound below limits it to 1.05^8 ~ 1.5) so the
+                # max|u| may be (1 + max_inflight) * read_every ~ 12 steps
+                # stale with the round-4 non-blocking reader (sim/pack.py):
+                # assume it can have grown 1.5x since measured (the dt
+                # growth bound below limits it to 1.03^12 ~ 1.43) so the
                 # EFFECTIVE CFL never exceeds the configured value — a
                 # sharp-chi fish at full gait measurably blows up without
                 # this margin while the fresh-umax host path is stable
                 umax = 1.5 * umax
             dt_adv = cfl * h / max(umax, 1e-12)
             if cfg.pipelined and prev_dt > 0:
-                dt_adv = min(dt_adv, 1.05 * prev_dt)
+                dt_adv = min(dt_adv, 1.03 * prev_dt)
             if cfg.implicitDiffusion:
                 # a from-rest flow is diffusion-dominated: keep the explicit
                 # cap until any velocity scale exists, else dt_adv blows up

@@ -201,3 +201,35 @@ def test_pipelined_umax_tracks_flow():
     assert all(np.isfinite(d) and d > 0 for d in dts)
     for a, b in zip(dts, dts[1:]):
         assert b <= 1.05 * a + 1e-12
+
+
+def test_device_dt_chain_matches_host_policy():
+    """Device-resident dt chain (dtDevice=1, obstacle-free CFL runs)
+    implements the NON-pipelined fresh-umax dt policy exactly (no 1.5x
+    staleness margin, no growth cap): compare against pipelined=False.
+    Only f32-vs-f64 dt round-off separates the trajectories."""
+    def run(pipe, dt_device):
+        cfg = SimulationConfig(
+            bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=0,
+            extent=float(2 * np.pi), CFL=0.3, Rtol=1.8, Ctol=0.05,
+            nu=1e-3, tend=0.0, nsteps=8, rampup=0,
+            poissonSolver="iterative", poissonTol=1e-6, poissonTolRel=1e-4,
+            initCond="taylorGreen", verbose=False, freqDiagnostics=0,
+            pipelined=pipe, dtDevice=dt_device,
+        )
+        sim = AMRSimulation(cfg)
+        sim.init()
+        sim.adapt_enabled = False
+        assert sim._use_device_dt() == (dt_device == 1)
+        sim.simulate()
+        sim.flush_packs()
+        return sim
+
+    dev, host = run(True, 1), run(False, 0)
+    # time is a device scalar on the chain; both end after 8 CFL steps
+    t_dev = float(np.asarray(dev.time))
+    assert abs(t_dev - host.time) < 1e-4 * max(host.time, 1e-12)
+    np.testing.assert_allclose(
+        np.asarray(dev.state["vel"]), np.asarray(host.state["vel"]),
+        atol=2e-4,
+    )

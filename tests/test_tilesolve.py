@@ -1,0 +1,93 @@
+"""Exact fast-diagonalization tile solve (ops/tilesolve.py) vs the CG
+reference (krylov.block_cg_tiles_reference) — the round-4 getZ swap must
+solve the identical per-tile system (-lap_tile + shift) z = b."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup3d_tpu.ops import tilesolve
+from cup3d_tpu.ops.krylov import block_cg_tiles_reference
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+def test_blocks_matches_cg_reference():
+    b = _rand((5, 8, 8, 8))
+    z = tilesolve.tile_solve_blocks(b)
+    z_ref = block_cg_tiles_reference(b, 300)
+    assert float(jnp.max(jnp.abs(z - z_ref))) < 5e-5
+
+
+def test_blocks_residual_exact():
+    # (-lap + 0) z = b should hold to f32 roundoff, unlike truncated CG
+    from cup3d_tpu.ops.krylov import _block_lap
+
+    b = _rand((3, 8, 8, 8), seed=1)
+    z = tilesolve.tile_solve_blocks(b)
+    r = b - (-_block_lap(z))
+    assert float(jnp.max(jnp.abs(r))) < 1e-4
+
+
+def test_scalar_shift():
+    from cup3d_tpu.ops.krylov import _block_lap
+
+    b = _rand((3, 8, 8, 8), seed=2)
+    z = tilesolve.tile_solve_blocks(b, shift=2.5)
+    r = b - (-_block_lap(z) + 2.5 * z)
+    assert float(jnp.max(jnp.abs(r))) < 1e-4
+
+
+def test_per_block_shift():
+    from cup3d_tpu.ops.krylov import _block_lap
+
+    b = _rand((4, 8, 8, 8), seed=3)
+    shift = jnp.asarray([0.1, 1.0, 3.0, 10.0]).reshape(4, 1, 1, 1)
+    z = tilesolve.tile_solve_blocks(b, shift=shift)
+    r = b - (-_block_lap(z) + shift * z)
+    assert float(jnp.max(jnp.abs(r))) < 1e-4
+
+
+def test_lanes_matches_blocks():
+    b = _rand((6, 8, 8, 8), seed=4)
+    bt = jnp.moveaxis(b, 0, -1)
+    z_blocks = tilesolve.tile_solve_blocks(b)
+    z_lanes = jnp.moveaxis(tilesolve.tile_solve_lanes(bt), -1, 0)
+    np.testing.assert_allclose(np.asarray(z_blocks), np.asarray(z_lanes),
+                               rtol=0, atol=1e-5)
+
+
+def test_lanes_shift_vector():
+    from cup3d_tpu.ops.krylov import _block_lap
+
+    b = _rand((4, 8, 8, 8), seed=5)
+    shift = jnp.asarray([0.5, 1.5, 4.0, 8.0])
+    zt = tilesolve.tile_solve_lanes(jnp.moveaxis(b, 0, -1), shift=shift)
+    z = jnp.moveaxis(zt, -1, 0)
+    r = b - (-_block_lap(z) + shift.reshape(4, 1, 1, 1) * z)
+    assert float(jnp.max(jnp.abs(r))) < 1e-4
+
+
+def test_float64():
+    from cup3d_tpu.ops.krylov import _block_lap
+
+    b = _rand((2, 8, 8, 8), seed=6).astype(jnp.float64)
+    z = tilesolve.tile_solve_blocks(b)
+    assert z.dtype == b.dtype
+    r = b - (-_block_lap(z))
+    tol = 1e-10 if jax.config.jax_enable_x64 else 1e-4
+    assert float(jnp.max(jnp.abs(r))) < tol
+
+
+def test_getz_dispatch_env(monkeypatch):
+    from cup3d_tpu.ops import krylov
+
+    b = _rand((3, 8, 8, 8), seed=7)
+    monkeypatch.delenv("CUP3D_GETZ", raising=False)
+    z_exact = krylov.getz_blocks(b)
+    monkeypatch.setenv("CUP3D_GETZ", "cg")
+    z_cg = krylov.getz_blocks(b, cg_iters=300)
+    assert float(jnp.max(jnp.abs(z_exact - z_cg))) < 5e-5

@@ -1,0 +1,92 @@
+"""Bisect the BENCH_r02 -> r03 div_max_fluid regression on the 128^3 fish
+(0.00267 -> 0.0305; VERDICT r3 weak item 5 / next item 5).
+
+Candidates: (a) depth-2 pipelining (stale dt/umax), (b) the round-3 Towers
+chi (sharper band -> different fluid mask and near-band gradients).
+Runs the identical bench config 121 steps in three variants and prints one
+JSON line with div_max / div_max_fluid each.
+
+Usage: python validation/bisect_divfluid.py [N]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run(pipelined: bool, towers: bool, n: int = 128):
+    import jax.numpy as jnp
+
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.models import base as mb
+    from cup3d_tpu.ops import diagnostics as diag
+    from cup3d_tpu.sim.simulation import Simulation
+
+    from cup3d_tpu.models.fish import stefanfish as sf
+
+    orig_create = mb.Obstacle.create
+    orig_fish_create = sf.StefanFish.create
+    if not towers:
+        def sine_create(self, t):
+            from cup3d_tpu.ops.chi import heaviside
+
+            sdf, udef = self.rasterize(t)
+            self.sdf = sdf
+            self.chi = heaviside(sdf, self.sim.grid.h)
+            if udef is None:
+                udef = jnp.zeros(self.sim.grid.shape + (3,), self.sim.dtype)
+            self.udef = udef * (self.chi > 0)[..., None]
+        mb.Obstacle.create = sine_create
+        sf.StefanFish.create = sine_create
+    try:
+        bpd = n // 8
+        cfg = SimulationConfig(
+            bpdx=bpd, bpdy=bpd, bpdz=bpd, levelMax=1, levelStart=0,
+            extent=1.0, CFL=0.4, nu=1e-3, tend=0.0, nsteps=10**9,
+            rampup=100, poissonSolver="iterative", poissonTol=1e-6,
+            poissonTolRel=1e-4,
+            factory_content=(
+                "StefanFish L=0.4 T=1.0 xpos=0.5 ypos=0.5 zpos=0.5 "
+                "bFixFrameOfRef=1 heightProfile=danio widthProfile=stefan"
+            ),
+            verbose=False, freqDiagnostics=0, pipelined=pipelined,
+        )
+        sim = Simulation(cfg)
+        sim.init()
+        for _ in range(121):
+            sim.advance(sim.calc_max_timestep())
+        sim.flush_packs()
+        _, div_max = diag.divergence_norms(sim.sim.grid, sim.sim.state["vel"])
+        div_fluid = diag.fluid_divergence_max(
+            sim.sim.grid, sim.sim.state["vel"], sim.sim.state["chi"]
+        )
+        umax = float(jnp.max(jnp.abs(sim.sim.state["vel"])))
+        return {"div_max": float(div_max), "div_max_fluid": float(div_fluid),
+                "umax": umax}
+    finally:
+        mb.Obstacle.create = orig_create
+        sf.StefanFish.create = orig_fish_create
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    out = {}
+    for name, pipe, towers in (
+        ("pipelined_towers", True, True),    # BENCH_r03 config
+        ("host_towers", False, True),        # isolates pipelining
+        ("host_sine", False, False),         # isolates the chi change (r2)
+    ):
+        try:
+            out[name] = run(pipe, towers, n)
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        print(name, out[name], flush=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
