@@ -162,7 +162,12 @@ def bench_fish_uniform(n_default: int = 128):
 
     _, div_max = diag.divergence_norms(sim.sim.grid, sim.sim.state["vel"])
     # incompressibility away from the chi band (inside it the Brinkman
-    # forcing is a legitimate momentum source; see fluid_divergence_max)
+    # forcing is a legitimate momentum source; see fluid_divergence_max).
+    # Gate (VERDICT r3 item 5, bisected r4): the level is set by the
+    # Towers chi sharpening the pressure RHS at the reference's own
+    # 1e-6/1e-4 tolerance — the reference binary measures 0.04-0.11 on
+    # the same configs (validation/results/parity_*/parity_div.txt);
+    # ours run 0.02-0.04.  0.15 trips only on a real regression.
     div_fluid = diag.fluid_divergence_max(
         sim.sim.grid, sim.sim.state["vel"], sim.sim.state["chi"]
     )
@@ -228,6 +233,7 @@ def bench_fish_uniform(n_default: int = 128):
         "wall_per_step_max_s": round(wall_max, 4),
         "div_max": float(div_max),
         "div_max_fluid": float(div_fluid),
+        "div_fluid_gate_ok": bool(float(div_fluid) < 0.15),
         "bicgstab_iters_to_tol": int(k_cold),
         "bicgstab_iters_warm_restart": k_warm,
         "bicgstab_iters_per_s": round(int(k2) / max(t_cold, 1e-9), 1),
@@ -268,8 +274,24 @@ def _lanes_roofline(A, M, rhs):
         return (time.perf_counter() - t0) / n
 
     per_iter = max((timed(f25) - timed(f5)) / 20.0, 1e-9)
-    return _roofline_dict(per_iter, cells, flops_per_cell=2100.0,
-                          bytes_per_cell=90.0)
+    gz_flops, gz_bytes = _getz_cost_model()
+    # per cell-iteration: 2 Laplacians (~8 flop, ~4 passes) + 2 getZ +
+    # ~10 vector ops (~1 flop, 2 passes each)
+    return _roofline_dict(per_iter, cells,
+                          flops_per_cell=26.0 + 2.0 * gz_flops,
+                          bytes_per_cell=74.0 + 2.0 * gz_bytes)
+
+
+def _getz_cost_model():
+    """(flops, bytes) per cell per getZ application, matching the kernel
+    the CUP3D_GETZ knob actually dispatches (ops/krylov.use_exact_getz):
+    exact tile solve = one 512-wide MAC row on the MXU (~1024 flop, 2 HBM
+    passes); legacy 24-sweep CG = ~24 x 17 VPU flops, ~2 passes."""
+    from cup3d_tpu.ops import krylov
+
+    if krylov.use_exact_getz():
+        return 1024.0, 8.0
+    return 420.0, 8.0
 
 
 def _roofline_dict(per_iter: float, cells: int, flops_per_cell: float,
@@ -501,8 +523,11 @@ def _amr_roofline(sim):
         return (time.perf_counter() - t0) / n
 
     per_iter = max((timed(f25) - timed(f5)) / 20.0, 1e-9)
-    return _roofline_dict(per_iter, cells, flops_per_cell=2100.0,
-                          bytes_per_cell=110.0)
+    gz_flops, gz_bytes = _getz_cost_model()
+    # AMR adds the reflux/halo traffic: ~6 passes per Laplacian
+    return _roofline_dict(per_iter, cells,
+                          flops_per_cell=26.0 + 2.0 * gz_flops,
+                          bytes_per_cell=94.0 + 2.0 * gz_bytes)
 
 
 def bench_two_fish_amr():

@@ -198,6 +198,16 @@ class Obstacle:
         self.pres_force = np.zeros(3)
         self.visc_force = np.zeros(3)
         self.pow_out = 0.0
+        self.pout_bnd = 0.0
+        self.thrust = 0.0
+        self.drag = 0.0
+        self.def_power = 0.0
+        self.def_power_bnd = 0.0
+        self.p_locom = 0.0
+        self.Pthrust = 0.0
+        self.Pdrag = 0.0
+        self.EffPDef = 0.0
+        self.EffPDefBnd = 0.0
         # collision latch (reference collision_counter/u_collision,
         # main.cpp:7546-7552, 13069-13077)
         self.collision_counter = 0.0
@@ -235,6 +245,23 @@ class Obstacle:
     def rasterize(self, t: float):
         """Return (sdf, udef) dense fields; sdf > 0 inside, udef (.,3)."""
         raise NotImplementedError
+
+    def max_body_speed(self, uinf=None) -> float:
+        """Fresh host-side bound on this body's maximum material speed in
+        the sim frame: rigid translation (+ frame velocity) + rotation at
+        the body radius (+ deformation; fish override).  The pipelined dt
+        chain floors its CFL scale with this: the packed fluid max|u| can
+        lag ~(1+max_inflight)*read_every steps, but the body kinematics
+        that DRIVE the acceleration are known on host exactly — measured
+        at 256^3, a gait spin-up outruns the stale mirror while dt sits
+        at the diffusive cap and the run blows through CFL (the reference
+        never faces this: findMaxU re-measures every step,
+        main.cpp:8603-8623)."""
+        tv = np.asarray(self.transVel, np.float64)
+        if uinf is not None:
+            tv = tv + np.asarray(uinf, np.float64)
+        om = float(np.linalg.norm(np.asarray(self.angVel, np.float64)))
+        return float(np.linalg.norm(tv)) + om * 0.5 * float(self.length)
 
     def update_shape(self, t: float, dt: float) -> None:
         """Advance internal deformation kinematics (fish midline etc.)."""
@@ -384,8 +411,16 @@ class Obstacle:
 # main.cpp:13783)
 
 _MOMENT_KEYS = ("mass", "center", "lin_mom", "ang_mom", "inertia")
-_FORCE_KEYS = ("pres_force", "visc_force", "torque", "power", "thrust",
-               "drag", "def_power")
+_FORCE_KEYS = ("pres_force", "visc_force", "torque", "power", "pout_bnd",
+               "thrust", "drag", "def_power", "def_power_bnd", "p_locom",
+               "n_surf")
+# packed force-vector width (3+3+3 vectors + 7 scalars + n_surf): the full
+# 19-QoI reduction set of the reference's ComputeForces
+# (main.cpp:13089-13108 — surfForce there is presForce+viscForce, derived
+# on unpack here) plus the probe's surface-cell count (drives the
+# compacted probe's adaptive slot budget, ops/surface.py
+# obstacle_probe_budget)
+FORCE_PACK = 17
 
 
 def pack_moments(m: Dict[str, jnp.ndarray]) -> jnp.ndarray:
@@ -405,9 +440,13 @@ def unpack_moments(a) -> Dict[str, np.ndarray]:
 
 
 def pack_forces(f: Dict[str, jnp.ndarray]) -> jnp.ndarray:
-    """Force-integral dict -> (13,) device vector."""
+    """Force-integral dict -> (FORCE_PACK,) device vector.  Band-integral
+    producers (force_integrals) lack the probe-only clipped/locomotion
+    QoI; those slots pack as 0."""
+    z = jnp.zeros((), jnp.result_type(*(jnp.asarray(f[k]).dtype
+                                        for k in ("power", "thrust"))))
     return jnp.concatenate(
-        [jnp.reshape(jnp.asarray(f[k]), (-1,)) for k in _FORCE_KEYS]
+        [jnp.reshape(jnp.asarray(f.get(k, z)), (-1,)) for k in _FORCE_KEYS]
     )
 
 
@@ -418,22 +457,30 @@ def unpack_forces(a) -> Dict[str, np.ndarray]:
         "visc_force": a[3:6],
         "torque": a[6:9],
         "power": float(a[9]),
-        "thrust": float(a[10]),
-        "drag": float(a[11]),
-        "def_power": float(a[12]),
+        "pout_bnd": float(a[10]),
+        "thrust": float(a[11]),
+        "drag": float(a[12]),
+        "def_power": float(a[13]),
+        "def_power_bnd": float(a[14]),
+        "p_locom": float(a[15]),
+        "n_surf": float(a[16]),
     }
 
 
 def derived_force_qoi(f: Dict[str, np.ndarray], trans_vel: np.ndarray,
                       eps: float = 1e-21) -> Dict[str, float]:
     """Host-side derived swimming QoI (reference computeForces tail,
-    main.cpp:13098-13114): thrust/drag powers and deformation efficiency."""
+    main.cpp:13098-13114): thrust/drag powers and deformation
+    efficiencies (EffPDefBnd uses the clipped defPowerBnd, which is
+    <= 0 by construction)."""
     vnorm = float(np.linalg.norm(trans_vel))
     pthrust = f["thrust"] * vnorm
     pdrag = f["drag"] * vnorm
     def_power = f["def_power"]
     eff = pthrust / (pthrust - min(def_power, 0.0) + eps)
-    return {"Pthrust": pthrust, "Pdrag": pdrag, "EffPDef": eff}
+    eff_bnd = pthrust / (pthrust - f.get("def_power_bnd", 0.0) + eps)
+    return {"Pthrust": pthrust, "Pdrag": pdrag, "EffPDef": eff,
+            "EffPDefBnd": eff_bnd}
 
 
 def momentum_integrals_core(x: jnp.ndarray, vol, chi: jnp.ndarray,
@@ -531,19 +578,31 @@ def store_force_qoi(ob, f: Dict[str, np.ndarray]) -> None:
     ob.force = ob.pres_force + ob.visc_force
     ob.torque = f["torque"]
     ob.pow_out = f["power"]
+    ob.pout_bnd = f.get("pout_bnd", 0.0)
     ob.thrust = f["thrust"]
     ob.drag = f["drag"]
     ob.def_power = f["def_power"]
+    ob.def_power_bnd = f.get("def_power_bnd", 0.0)
+    ob.p_locom = f.get("p_locom", 0.0)
+    # measured surface-band size: feeds the compacted probe's adaptive
+    # slot budget (ops/surface.obstacle_probe_budget)
+    n_surf = f.get("n_surf", 0.0)
+    if n_surf > 0:
+        ob.n_surf_points = n_surf
     d = derived_force_qoi(f, ob.transVel)
     ob.Pthrust, ob.Pdrag, ob.EffPDef = d["Pthrust"], d["Pdrag"], d["EffPDef"]
+    ob.EffPDefBnd = d["EffPDefBnd"]
 
 
 def log_forces(logger, i: int, time: float, ob) -> None:
+    """forces_<i>.txt row: the reference's full per-obstacle QoI set
+    (computeForces reduction + derived tail, main.cpp:13089-13114)."""
     logger.write(
         f"forces_{i}.txt",
         f"{time:.8e} " + " ".join(f"{v:.8e}" for v in ob.force)
-        + f" {ob.pow_out:.8e} {ob.thrust:.8e} {ob.drag:.8e}"
-        + f" {ob.def_power:.8e} {ob.EffPDef:.8e}\n",
+        + f" {ob.pow_out:.8e} {ob.pout_bnd:.8e} {ob.thrust:.8e}"
+        + f" {ob.drag:.8e} {ob.def_power:.8e} {ob.def_power_bnd:.8e}"
+        + f" {ob.p_locom:.8e} {ob.EffPDef:.8e} {ob.EffPDefBnd:.8e}\n",
     )
 
 

@@ -134,6 +134,15 @@ class StefanFish(Obstacle):
         self.myFish.integrate_angular_momentum(max(dt, 1e-12))
         self._update_sensor_locations()
 
+    def max_body_speed(self, uinf=None) -> float:
+        """Rigid bound + the midline's max deformation speed — the fast,
+        host-exact part of the fish's material velocity (see
+        Obstacle.max_body_speed for why the pipelined dt chain needs
+        this fresh)."""
+        base = super().max_body_speed(uinf)
+        v = np.asarray(self.myFish.v, np.float64)
+        return base + float(np.sqrt((v * v).sum(-1).max()))
+
     def _apply_position_pid(self, dt: float) -> None:
         """alpha/beta/gamma corrections (StefanFish::create,
         main.cpp:15716-15778)."""

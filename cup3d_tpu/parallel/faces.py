@@ -50,9 +50,10 @@ __all__ = ["ShardedFaceTables", "build_sharded_face_tables"]
 class _ExchangePlan:
     """One all_to_all at entry granularity: send_idx[t, s, :] = rows (in
     t's local address space) that shard s needs from t; recv region offset
-    in the destination address space."""
+    in the destination address space.  send_idx None = nothing crosses
+    shards for this exchange; the kernel skips the collective."""
 
-    send_idx: jnp.ndarray  # (D, D, M) int32, sharded on axis 0
+    send_idx: Optional[jnp.ndarray]  # (D, D, M) int32, sharded on axis 0
     M: int
     recv_off: int
 
@@ -104,9 +105,14 @@ class _EntrySpace:
 
 def _plan_exchange(
     space: _EntrySpace, needed: List[set], D: int
-) -> Tuple[np.ndarray, int]:
+) -> Tuple[Optional[np.ndarray], int]:
     """needed[s] = set of global entries shard s must receive.  Returns
-    (send_idx (D, D, M), M) and registers the recv region + maps."""
+    (send_idx (D, D, M), M) and registers the recv region + maps.  When NO
+    shard needs anything remote, returns (None, 0) and registers an empty
+    region — the kernel skips the all_to_all entirely (Hilbert contiguity
+    makes most pyramid groups fully shard-local, and one needless
+    collective per group per assembly lands inside every Krylov
+    iteration; code-review r4)."""
     groups = []
     for s in range(D):
         by_src: List[List[int]] = [[] for _ in range(D)]
@@ -115,6 +121,10 @@ def _plan_exchange(
             if t != s:
                 by_src[t].append(e)
         groups.append(by_src)
+    if not any(g for gs in groups for g in gs):
+        space.recv_regions.append(0)
+        space.recv_maps.append([dict() for _ in range(D)])
+        return None, 0
     M = max([len(g) for gs in groups for g in gs] + [1])
     send_idx = np.zeros((D, D, M), np.int64)
     recv_maps: List[Dict[int, int]] = [dict() for _ in range(D)]
@@ -201,17 +211,19 @@ class ShardedFaceTables:
             for (dst, child, plan), (dst_a, child_a, send_a) in zip(
                 self_t.groups, grp_tabs
             ):
-                ext = _exchange_entries(
-                    ext, send_a[0], axis, plan.recv_off, plan.M
-                )
+                if send_a is not None:  # else: fully shard-local group
+                    ext = _exchange_entries(
+                        ext, send_a[0], axis, plan.recv_off, plan.M
+                    )
                 ch = jnp.take(ext, child_a[0], axis=0)  # (nsg,8,C,bs^3)
                 sh = _restrict8(ch, bs)
                 ext = ext.at[dst_a[0]].set(sh.astype(ext.dtype))
             # -- final exchange: face sources + coarse windows --------------
-            ext = _exchange_entries(
-                ext, final_send[0], axis, self_t.final_plan.recv_off,
-                self_t.final_plan.M,
-            )
+            if final_send is not None:
+                ext = _exchange_entries(
+                    ext, final_send[0], axis, self_t.final_plan.recv_off,
+                    self_t.final_plan.M,
+                )
             # -- dense face assembly (grid/faces.py math) -------------------
             lab = jnp.zeros((nbs, C) + (L,) * 3, fields.dtype)
             lab = lab.at[:, :, w:w + bs, w:w + bs, w:w + bs].set(fm)
@@ -416,7 +428,9 @@ def build_sharded_face_tables(forest, width: int) -> ShardedFaceTables:
             jnp.asarray(dst, jnp.int32),
             jnp.asarray(child, jnp.int32),
             _ExchangePlan(
-                send_idx=jnp.asarray(send_idx, jnp.int32), M=M,
+                send_idx=(None if send_idx is None
+                          else jnp.asarray(send_idx, jnp.int32)),
+                M=M,
                 recv_off=region_offs[x],
             ),
         ))
@@ -466,11 +480,15 @@ def build_sharded_face_tables(forest, width: int) -> ShardedFaceTables:
         scratch_row=space.scratch_row(),
         groups=tuple(
             (pad(dst), pad(child),
-             _ExchangePlan(pad(plan.send_idx), plan.M, plan.recv_off))
+             _ExchangePlan(
+                 None if plan.send_idx is None else pad(plan.send_idx),
+                 plan.M, plan.recv_off))
             for dst, child, plan in groups
         ),
         final_plan=_ExchangePlan(
-            pad(jnp.asarray(final_send, jnp.int32)), final_M,
+            (None if final_send is None
+             else pad(jnp.asarray(final_send, jnp.int32))),
+            final_M,
             region_offs[-1],
         ),
         src=pad(jnp.asarray(src_sh, jnp.int32)),

@@ -36,6 +36,7 @@ from cup3d_tpu.grid.octree import Octree, TreeConfig
 from cup3d_tpu.grid.uniform import BC
 from cup3d_tpu.io.logging import BufferedLogger, Profiler
 from cup3d_tpu.models.base import (
+    FORCE_PACK,
     RIGID_PACK,
     log_forces,
     momentum_integrals_core,
@@ -312,7 +313,8 @@ class AMRSimulation:
             per_obstacle_penalization_force(vn, vo, chis, dt, vol, xc, cms),
             self._vol, self._xc,
         )
-        # ALL obstacles' force QoI in one (n_obs, 13) host read per step
+        # ALL obstacles' force QoI in one (n_obs, FORCE_PACK) host read per
+        # step
         # per-obstacle rigid+deformation velocity field from the cached
         # device cell centers (avoids Obstacle.body_velocity_field's host
         # rebuild of cell_centers every step)
@@ -424,10 +426,19 @@ class AMRSimulation:
             pack_forces, pack_moments, rigid_update_device,
         )
         from cup3d_tpu.models.collisions import overlap_count
+        from cup3d_tpu.ops.surface import obstacle_probe_budget
 
         cfg = self.cfg
         g = self.grid
         nu = self.nu
+        # probe slot budgets are STATIC inside the trace: snapshot them at
+        # build time and let advance_pipelined trigger a rebuild when the
+        # adaptive budget moves (code-review r4 — without this, a
+        # static-mesh run freezes the generous pre-measurement prior)
+        hf0 = float(g.h0 / (1 << (len(g._slot_maps) - 1)))
+        self._megastep_budgets = tuple(
+            obstacle_probe_budget(ob, hf0) for ob in self.obstacles
+        )
         rigid_vmapped = jax.vmap(
             rigid_update_device, in_axes=(0, 0, 0, 0, None, None)
         )
@@ -529,8 +540,11 @@ class AMRSimulation:
             )
 
             # surface-point probe per obstacle (ops/surface.py): the
-            # production force measure, on the obstacle's dense window
-            from cup3d_tpu.ops.surface import probe_blocks_core
+            # production force measure, on the obstacle's dense window,
+            # compacted to a static per-obstacle point budget
+            from cup3d_tpu.ops.surface import (
+                obstacle_probe_budget, probe_blocks_core,
+            )
 
             F = jnp.stack(
                 [
@@ -540,6 +554,7 @@ class AMRSimulation:
                             slots[i], b0s[i],
                             jnp.asarray(h_fine, vel.dtype), nu,
                             cm_new[i], out[i, 0:3], out[i, 3:6],
+                            max_points=self._megastep_budgets[i],
                         )
                     )
                     for i in range(n_obs)
@@ -882,7 +897,15 @@ class AMRSimulation:
             if not cfg.pipelined:
                 self._umax_next = None
             # pipelined: keep the latest consumed max|u| (the reader may
-            # still be in flight); staleness is bounded by two steps
+            # still be in flight), floored by the fresh host-side body
+            # speed — a gait spin-up outruns the stale mirror (measured
+            # blow-up at 256^3; see Obstacle.max_body_speed)
+            if cfg.pipelined and self.obstacles:
+                umax = max(
+                    umax,
+                    max(ob.max_body_speed(self.uinf)
+                        for ob in self.obstacles),
+                )
         else:
             umax = float(self._maxu(self.state["vel"], self.uinf_device()))
             if self.obstacles:
@@ -892,7 +915,9 @@ class AMRSimulation:
                     umax,
                     float(jnp.max(jnp.abs(self.state["udef"]))),
                 )
-        if umax > cfg.uMax_allowed:
+        if not np.isfinite(umax) or umax > cfg.uMax_allowed:
+            # NaN must trip the abort too: `NaN > x` is False, and a NaN
+            # umax would otherwise propagate into dt (code-review r4)
             self.logger.flush()
             raise RuntimeError(f"runaway velocity: max|u|={umax:.3g}")
         if cfg.dt > 0:
@@ -1127,6 +1152,17 @@ class AMRSimulation:
                 self.adapt_mesh()
         with self.profiler("CreateObstacles"):
             self.create_obstacles(dt, combine=False)
+        # the probe slot budgets are baked into the megastep trace; when
+        # the adaptive budget moves (first n_surf measurement landing, or
+        # band growth past the hysteresis window) retrace once
+        from cup3d_tpu.ops.surface import obstacle_probe_budget
+
+        hf = float(self.grid.h0 / (1 << (len(self.grid._slot_maps) - 1)))
+        budgets = tuple(
+            obstacle_probe_budget(ob, hf) for ob in self.obstacles
+        )
+        if budgets != self._megastep_budgets:
+            self._build_megastep(self._geom)
         with self.profiler("Megastep"):
             n = len(self.obstacles)
             from cup3d_tpu.ops.surface import block_window_slots
@@ -1210,7 +1246,8 @@ class AMRSimulation:
         with self.profiler("SyncQoI"):
             npairs = n * (n - 1) // 2
             layout = [("rigid", n * RIGID_PACK), ("penal", n * 6),
-                      ("forces", n * 13), ("overlap", npairs), ("flux", 1),
+                      ("forces", n * FORCE_PACK), ("overlap", npairs),
+                      ("flux", 1),
                       ("umax", 1)]
             # grouped deferred read (sim/pack.py): K packs -> one device
             # concat -> one worker-thread fetch, amortizing the tunnel's
@@ -1323,7 +1360,8 @@ class AMRSimulation:
                     ob.penal_torque = seg[6 * i + 3:6 * i + 6]
             elif name == "forces":
                 for i, ob in enumerate(self.obstacles):
-                    store_force_qoi(ob, unpack_forces(seg[13 * i:13 * (i + 1)]))
+                    store_force_qoi(ob, unpack_forces(
+                        seg[FORCE_PACK * i:FORCE_PACK * (i + 1)]))
                     log_forces(self.logger, i, entry["time"], ob)
             elif name == "overlap":
                 if np.any(seg > 0):
@@ -1376,7 +1414,8 @@ class AMRSimulation:
                     ob.penal_torque = seg[6 * i + 3:6 * i + 6]
             elif name == "forces":
                 for i, ob in enumerate(self.obstacles):
-                    store_force_qoi(ob, unpack_forces(seg[13 * i:13 * (i + 1)]))
+                    store_force_qoi(ob, unpack_forces(
+                        seg[FORCE_PACK * i:FORCE_PACK * (i + 1)]))
                     log_forces(self.logger, i, self.time, ob)
             elif name == "umax":
                 self._umax_next = float(seg[0])
@@ -1399,15 +1438,19 @@ class AMRSimulation:
         """Per-obstacle force/torque/power QoI from the surface-point
         probe (ops/surface.py; reference ComputeForces,
         main.cpp:12250-12503)."""
-        from cup3d_tpu.ops.surface import force_integrals_probe_blocks
+        from cup3d_tpu.ops.surface import (
+            force_integrals_probe_blocks, obstacle_probe_budget,
+        )
 
         s = self.state
+        h_fine = float(self.grid.h.min())
         rows = [
             pack_forces(
                 force_integrals_probe_blocks(
                     self.grid, {"vel": s["vel"], "p": s["p"]}, ob.chi,
                     ob.sdf, ob.udef, self.nu, ob.position, ob.length,
                     ob.centerOfMass, ob.transVel, ob.angVel,
+                    max_points=obstacle_probe_budget(ob, h_fine),
                 )
             )
             for ob in self.obstacles

@@ -112,7 +112,16 @@ class Simulation:
             if not self.cfg.pipelined:
                 self._umax_next = None
             # pipelined: keep the latest consumed max|u| — staleness is
-            # bounded by ~2x the grouped-read cadence (sim/pack.py)
+            # bounded by ~2x the grouped-read cadence (sim/pack.py) — and
+            # FLOOR it with the fresh host-side body speed: a gait
+            # spin-up outruns the stale mirror while dt sits at the
+            # diffusive cap (measured blow-up at 256^3; see
+            # Obstacle.max_body_speed)
+            if self.cfg.pipelined and s.obstacles:
+                umax = max(
+                    umax,
+                    max(ob.max_body_speed(s.uinf) for ob in s.obstacles),
+                )
         else:
             umax = float(self._max_u(s.state["vel"], s.uinf_device()))
             if s.obstacles:
@@ -126,7 +135,8 @@ class Simulation:
                 umax = max(
                     umax, float(_jnp.max(_jnp.abs(s.state["udef"])))
                 )
-        if umax > cfg.uMax_allowed:
+        if not np.isfinite(umax) or umax > cfg.uMax_allowed:
+            # NaN must trip the abort too (`NaN > x` is False; code-review r4)
             s.logger.flush()
             raise RuntimeError(
                 f"runaway velocity: max|u|={umax:.3g} > uMax_allowed={cfg.uMax_allowed}"

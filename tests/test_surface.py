@@ -114,3 +114,163 @@ def test_block_window_matches_dense():
     V = 4.0 / 3.0 * np.pi * r**3
     F = np.asarray(out["pres_force"])
     assert abs(F[0] + V) / V < 0.06, (F, V)
+
+
+def test_bnd_qoi_and_p_locom():
+    """PoutBnd/defPowerBnd are the negative-part sums (reference
+    main.cpp:12483-12485): <= 0 and <= the unclipped totals; with a pure
+    solid-body translation field and no deformation, pLocom equals Pout
+    exactly and defPower vanishes."""
+    h, xc, sdf, chi, c = _sphere_window()
+    ut = jnp.asarray([0.3, -0.1, 0.2], jnp.float32)
+    vel = jnp.broadcast_to(ut, sdf.shape + (3,))
+    p = jnp.asarray(xc[..., 0] ** 2 - xc[..., 1])
+    out = sf.surface_force_window(
+        vel, p, chi, sdf, jnp.zeros(sdf.shape + (3,), jnp.float32),
+        jnp.ones(sdf.shape, bool), jnp.asarray(xc), h, 1e-2,
+        jnp.asarray(c, jnp.float32), ut, jnp.zeros(3, jnp.float32),
+    )
+    pout = float(out["power"])
+    pout_bnd = float(out["pout_bnd"])
+    assert pout_bnd <= 1e-12
+    assert pout_bnd <= pout + 1e-12
+    assert float(out["def_power"]) == 0.0
+    assert float(out["def_power_bnd"]) == 0.0
+    # v = u_solid everywhere (omega = 0, udef = 0) -> pLocom == Pout
+    assert abs(float(out["p_locom"]) - pout) < 1e-5 * max(1.0, abs(pout))
+
+
+def test_force_pack_roundtrip_19_qoi():
+    """pack_forces/unpack_forces carry the full reference QoI set
+    (main.cpp:13089-13108) incl. the Bnd variants and pLocom."""
+    from cup3d_tpu.models.base import (
+        FORCE_PACK, derived_force_qoi, pack_forces, unpack_forces,
+    )
+
+    h, xc, sdf, chi, c = _sphere_window()
+    vel = jnp.asarray(np.random.default_rng(0).standard_normal(
+        sdf.shape + (3,)).astype(np.float32) * 0.1)
+    p = jnp.asarray(xc[..., 2])
+    out = sf.surface_force_window(
+        vel, p, chi, sdf, 0.05 * vel, jnp.ones(sdf.shape, bool),
+        jnp.asarray(xc), h, 1e-2, jnp.asarray(c, jnp.float32),
+        jnp.asarray([0.1, 0.0, 0.0], jnp.float32),
+        jnp.zeros(3, jnp.float32),
+    )
+    v = pack_forces(out)
+    assert v.shape == (FORCE_PACK,)
+    f = unpack_forces(v)
+    for k in ("power", "pout_bnd", "thrust", "drag", "def_power",
+              "def_power_bnd", "p_locom"):
+        assert abs(f[k] - float(out[k])) < 1e-5 * max(1.0, abs(f[k])), k
+    assert f["n_surf"] == float(out["n_surf"]) > 0
+    d = derived_force_qoi(f, np.array([0.1, 0.0, 0.0]))
+    assert "EffPDefBnd" in d and np.isfinite(d["EffPDefBnd"])
+
+
+def test_per_point_export_consistent_with_reductions():
+    """The per-point record (reference ObstacleBlock arrays,
+    main.cpp:12300-12330) compacts to n_surf rows whose column sums
+    reproduce the reduced forces."""
+    h, xc, sdf, chi, c = _sphere_window()
+    vel = jnp.asarray(np.random.default_rng(1).standard_normal(
+        sdf.shape + (3,)).astype(np.float32) * 0.1)
+    p = jnp.asarray(xc[..., 0])
+    out = sf.surface_force_window(
+        vel, p, chi, sdf, jnp.zeros(sdf.shape + (3,), jnp.float32),
+        jnp.ones(sdf.shape, bool), jnp.asarray(xc), h, 1e-2,
+        jnp.asarray(c, jnp.float32), jnp.zeros(3, jnp.float32),
+        jnp.zeros(3, jnp.float32), per_point=True,
+    )
+    rows = sf.compact_surface_points(out["points"])
+    assert rows.shape == (int(out["n_surf"]), len(sf.SURFACE_POINT_COLUMNS))
+    cols = {k: i for i, k in enumerate(sf.SURFACE_POINT_COLUMNS)}
+    fP_sum = rows[:, [cols["fxP"], cols["fyP"], cols["fzP"]]].sum(0)
+    fV_sum = rows[:, [cols["fxV"], cols["fyV"], cols["fzV"]]].sum(0)
+    np.testing.assert_allclose(fP_sum, np.asarray(out["pres_force"]),
+                               rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(fV_sum, np.asarray(out["visc_force"]),
+                               rtol=1e-4, atol=1e-7)
+    # dS column integrates to the sphere area like the reduction does
+    area = 4.0 * np.pi * 0.3**2
+    assert abs(rows[:, cols["dS"]].sum() - area) / area < 0.06
+
+
+def test_probe_budget_adaptation():
+    """obstacle_probe_budget: generous prior without a measurement, ~4x
+    the measured band once n_surf lands, hysteresis in [2x, 8x]."""
+    class Ob:
+        length = 0.4
+
+    ob = Ob()
+    k0 = sf.obstacle_probe_budget(ob, 1.0 / 128)
+    assert k0 == sf.probe_max_points(0.4, 1.0 / 128)
+    ob.n_surf_points = 2674.0
+    k1 = sf.obstacle_probe_budget(ob, 1.0 / 128)
+    assert 4 * 2674 <= k1 <= 4 * 2674 + 1024
+    # hysteresis: small drift keeps the budget (no retrace)
+    ob.n_surf_points = 3000.0
+    assert sf.obstacle_probe_budget(ob, 1.0 / 128) == k1
+    # large growth re-budgets
+    ob.n_surf_points = 10 * 2674.0
+    assert sf.obstacle_probe_budget(ob, 1.0 / 128) > k1
+
+
+def test_truncation_keeps_largest_measure():
+    """With max_points below the band size the top-K compaction keeps the
+    largest-dS cells: the buoyancy integral degrades gracefully (a few %),
+    and n_surf still reports the TRUE band size."""
+    h, xc, sdf, chi, c = _sphere_window()
+    p = jnp.asarray(xc[..., 0])
+    vel = jnp.zeros(sdf.shape + (3,), jnp.float32)
+    full = sf.surface_force_window(
+        vel, p, chi, sdf, jnp.zeros_like(vel), jnp.ones(sdf.shape, bool),
+        jnp.asarray(xc), h, 1e-2, jnp.asarray(c, jnp.float32),
+        jnp.zeros(3, jnp.float32), jnp.zeros(3, jnp.float32),
+    )
+    n_true = int(full["n_surf"])
+    K = max(1024, int(0.6 * n_true))
+    cut = sf.surface_force_window(
+        vel, p, chi, sdf, jnp.zeros_like(vel), jnp.ones(sdf.shape, bool),
+        jnp.asarray(xc), h, 1e-2, jnp.asarray(c, jnp.float32),
+        jnp.zeros(3, jnp.float32), jnp.zeros(3, jnp.float32),
+        max_points=K,
+    )
+    assert int(cut["n_surf"]) == n_true
+    F_full = np.asarray(full["pres_force"])
+    F_cut = np.asarray(cut["pres_force"])
+    rel = np.linalg.norm(F_cut - F_full) / max(np.linalg.norm(F_full), 1e-12)
+    assert rel < 0.15
+
+
+def test_dump_surface_points_driver(tmp_path):
+    """End-to-end: a sphere on the AMR driver dumps a compact per-point
+    surface record whose traction sums match the obstacle's stored
+    force QoI."""
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.sim.amr import AMRSimulation
+
+    cfg = SimulationConfig(
+        bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=1, extent=1.0,
+        CFL=0.3, nu=1e-3, tend=0.0, nsteps=3, rampup=0, dt=1e-3,
+        poissonSolver="iterative", poissonTol=1e-5, poissonTolRel=1e-3,
+        factory_content="Sphere radius=0.14 xpos=0.5 ypos=0.5 zpos=0.5 "
+                        "xvel=0.3 bForcedInSimFrame=1",
+        verbose=False, freqDiagnostics=0,
+    )
+    sim = AMRSimulation(cfg)
+    sim.init()
+    sim.simulate()
+    ob = sim.obstacles[0]
+    path = str(tmp_path / "surf.npy")
+    n = sf.dump_surface_points(
+        path, sim.grid, {"vel": sim.state["vel"], "p": sim.state["p"]},
+        ob, sim.nu,
+    )
+    rows = np.load(path)
+    assert rows.shape == (n, len(sf.SURFACE_POINT_COLUMNS)) and n > 0
+    cols = {k: i for i, k in enumerate(sf.SURFACE_POINT_COLUMNS)}
+    F = (rows[:, [cols["fxP"], cols["fyP"], cols["fzP"]]].sum(0)
+         + rows[:, [cols["fxV"], cols["fyV"], cols["fzV"]]].sum(0))
+    np.testing.assert_allclose(F, np.asarray(ob.force), rtol=1e-3,
+                               atol=1e-8)

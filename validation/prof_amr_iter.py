@@ -1,0 +1,91 @@
+"""Device-time breakdown of one AMR BiCGSTAB iteration at amr_tgv scale
+(~1400 blocks, 2-level): lab assembly vs Laplacian vs getZ vs vector ops.
+Drives the VERDICT r4 target of >=1G cell-iters/s on the AMR forest.
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python validation/prof_amr_iter.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.grid.blocks import BlockGrid
+from cup3d_tpu.grid.flux import build_flux_tables
+from cup3d_tpu.grid.octree import Octree, TreeConfig
+from cup3d_tpu.grid.uniform import BC
+from cup3d_tpu.ops import amr_ops, krylov
+
+
+def build_forest():
+    """~1400-block 2-level forest: 8^3 base, refined center ball (the
+    amr_tgv shape without the driver)."""
+    t = Octree(TreeConfig((8, 8, 8), 2, (True,) * 3), 0)
+    for key in list(t.leaves()):
+        lvl, ix, iy, iz = key
+        c = (np.array([ix, iy, iz]) + 0.5) / 8.0
+        if np.linalg.norm(c - 0.5) < 0.31:
+            t.refine(key)
+    g = BlockGrid(t, (2 * np.pi,) * 3, (BC.periodic,) * 3)
+    return g
+
+
+def timed(f, *args, n=8, warm=2):
+    r = f(*args)
+    for _ in range(warm - 1):
+        r = f(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    g = build_forest()
+    nb = g.nb
+    cells = nb * g.bs**3
+    print(f"blocks={nb} cells={cells}")
+    tab = g.face_tables(1)
+    ftab = build_flux_tables(g)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((nb, 8, 8, 8)).astype(np.float32))
+    h2 = jnp.asarray((g.h**2).reshape(nb, 1, 1, 1), jnp.float32)
+
+    asm = jax.jit(lambda v, t: t.assemble_scalar(v, 8))
+    lap = jax.jit(
+        lambda v, t, ft: amr_ops.laplacian_blocks(g, v, t, ft)
+    )
+    lap_noflux = jax.jit(lambda v, t: amr_ops.laplacian_blocks(g, v, t, None))
+    gz = jax.jit(lambda v: krylov.getz_blocks(-h2 * v))
+
+    t_asm = timed(asm, x, tab)
+    t_lap = timed(lap, x, tab, ftab)
+    t_lap0 = timed(lap_noflux, x, tab)
+    t_gz = timed(gz, x)
+
+    def kfix(b, t, ft, k):
+        A = lambda v: amr_ops.laplacian_blocks(g, v, t, ft)
+        M = lambda r: krylov.getz_blocks(-h2 * r)
+        return krylov.bicgstab(A, b, M=M, tol_abs=0.0, tol_rel=0.0,
+                               maxiter=k)[0]
+
+    f5 = jax.jit(lambda b, t, ft: kfix(b, t, ft, 5))
+    f25 = jax.jit(lambda b, t, ft: kfix(b, t, ft, 25))
+    t5 = timed(f5, x, tab, ftab, n=4)
+    t25 = timed(f25, x, tab, ftab, n=4)
+    per_iter = (t25 - t5) / 20.0
+
+    print(f"assemble_scalar(w=1):  {t_asm*1e3:7.3f} ms")
+    print(f"laplacian (reflux):    {t_lap*1e3:7.3f} ms")
+    print(f"laplacian (no flux):   {t_lap0*1e3:7.3f} ms")
+    print(f"getZ exact:            {t_gz*1e3:7.3f} ms")
+    print(f"bicgstab per-iter:     {per_iter*1e3:7.3f} ms "
+          f"(model: 2 lap + 2 getZ = {(2*t_lap+2*t_gz)*1e3:.3f} ms)")
+    print(f"cell-iters/s:          {cells/per_iter/1e6:.0f} M")
+
+
+if __name__ == "__main__":
+    main()
