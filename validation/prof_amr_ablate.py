@@ -1,0 +1,108 @@
+"""Ablation profile of the real AMR BiCGSTAB iteration: per-iter device
+cost via (k=25 minus k=5)/20 differencing on the actual solver, with parts
+swapped out one at a time.  This is the only robust timing regime on the
+tunneled device (micro-benchmarks of single ops are dominated by dispatch
+artifacts).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python validation/prof_amr_ablate.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.grid.blocks import BlockGrid
+from cup3d_tpu.grid.flux import build_flux_tables
+from cup3d_tpu.grid.octree import Octree, TreeConfig
+from cup3d_tpu.grid.uniform import BC
+from cup3d_tpu.ops import amr_ops, krylov
+
+
+def build_forest():
+    t = Octree(TreeConfig((8, 8, 8), 2, (True,) * 3), 0)
+    for key in list(t.leaves):
+        lvl, ix, iy, iz = key
+        c = (np.array([ix, iy, iz]) + 0.5) / 8.0
+        if np.linalg.norm(c - 0.5) < 0.31:
+            t.refine(key)
+    return BlockGrid(t, (2 * np.pi,) * 3, (BC.periodic,) * 3)
+
+
+def timed(f, *args, n=6):
+    r = f(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n
+
+
+def per_iter(make_fn, *args):
+    f5 = jax.jit(lambda *a: make_fn(5)(*a))
+    f25 = jax.jit(lambda *a: make_fn(25)(*a))
+    t5 = timed(f5, *args)
+    t25 = timed(f25, *args)
+    return (t25 - t5) / 20.0
+
+
+def main():
+    g = build_forest()
+    nb, cells = g.nb, g.nb * g.bs ** 3
+    print(f"blocks={nb} cells={cells}")
+    tab = g.face_tables(1)
+    ftab = build_flux_tables(g)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((nb, 8, 8, 8)).astype(np.float32))
+    h2 = jnp.asarray((g.h ** 2).reshape(nb, 1, 1, 1), jnp.float32)
+
+    def A_full(v, t, ft):
+        return amr_ops.laplacian_blocks(g, v, t, ft)
+
+    def A_noflux(v, t, _):
+        return amr_ops.laplacian_blocks(g, v, t, None)
+
+    def A_stencil_only(v, t, ft):
+        # 7-pt on the block interior only (no lab): ablates halo assembly
+        z = jnp.pad(v, [(0, 0)] + [(1, 1)] * 3)
+        return (
+            z[:, 2:, 1:-1, 1:-1] + z[:, :-2, 1:-1, 1:-1]
+            + z[:, 1:-1, 2:, 1:-1] + z[:, 1:-1, :-2, 1:-1]
+            + z[:, 1:-1, 1:-1, 2:] + z[:, 1:-1, 1:-1, :-2]
+            - 6.0 * v
+        )
+
+    M_exact = lambda r: krylov.getz_blocks(-h2 * r)
+    M_id = lambda r: r
+
+    def make(A, M):
+        def mk(k):
+            def run(b, t, ft):
+                return krylov.bicgstab(
+                    lambda v: A(v, t, ft), b, M=M,
+                    tol_abs=0.0, tol_rel=0.0, maxiter=k)[0]
+            return run
+        return mk
+
+    base = per_iter(make(A_full, M_exact), x, tab, ftab)
+    noflux = per_iter(make(A_noflux, M_exact), x, tab, ftab)
+    nolab = per_iter(make(A_stencil_only, M_exact), x, tab, ftab)
+    noM = per_iter(make(A_full, M_id), x, tab, ftab)
+    bare = per_iter(make(A_stencil_only, M_id), x, tab, ftab)
+
+    print(f"full iteration:        {base*1e3:7.3f} ms"
+          f"  ({cells/base/1e6:5.0f} M cell-iters/s)")
+    print(f"  - reflux:            {noflux*1e3:7.3f} ms"
+          f"  (flux corr = {(base-noflux)*1e3:.3f})")
+    print(f"  - lab (stencil only):{nolab*1e3:7.3f} ms"
+          f"  (halo asm = {(noflux-nolab)*1e3:.3f})")
+    print(f"  - getZ (M=I):        {noM*1e3:7.3f} ms"
+          f"  (getZ     = {(base-noM)*1e3:.3f})")
+    print(f"  bare recurrence:     {bare*1e3:7.3f} ms"
+          f"  (vec ops + dots + loop)")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,7 @@ def build_forest():
     """~1400-block 2-level forest: 8^3 base, refined center ball (the
     amr_tgv shape without the driver)."""
     t = Octree(TreeConfig((8, 8, 8), 2, (True,) * 3), 0)
-    for key in list(t.leaves()):
+    for key in list(t.leaves):
         lvl, ix, iy, iz = key
         c = (np.array([ix, iy, iz]) + 0.5) / 8.0
         if np.linalg.norm(c - 0.5) < 0.31:

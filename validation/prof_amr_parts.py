@@ -1,0 +1,91 @@
+"""In-loop device cost of each BiCGSTAB-iteration component at amr_tgv
+scale: each part is timed as a jitted fori_loop of K chained applications,
+so per-application cost excludes host dispatch (the same regime as the real
+while_loop solve).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python validation/prof_amr_parts.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.grid.blocks import BlockGrid
+from cup3d_tpu.grid.flux import build_flux_tables
+from cup3d_tpu.grid.octree import Octree, TreeConfig
+from cup3d_tpu.grid.uniform import BC
+from cup3d_tpu.ops import amr_ops, krylov
+
+
+def build_forest():
+    t = Octree(TreeConfig((8, 8, 8), 2, (True,) * 3), 0)
+    for key in list(t.leaves):
+        lvl, ix, iy, iz = key
+        c = (np.array([ix, iy, iz]) + 0.5) / 8.0
+        if np.linalg.norm(c - 0.5) < 0.31:
+            t.refine(key)
+    return BlockGrid(t, (2 * np.pi,) * 3, (BC.periodic,) * 3)
+
+
+K = 40
+
+
+def chain(f):
+    """jit(x -> f applied K times), data-dependent chaining."""
+    def run(x, *args):
+        def body(_, v):
+            y = f(v, *args)
+            # keep shape: reduce back if f changed it
+            return y if y.shape == v.shape else v + jnp.sum(y) * 0
+        return jax.lax.fori_loop(0, K, body, x)
+    return jax.jit(run)
+
+
+def timed(f, *args, n=4):
+    r = f(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n / K
+
+
+def main():
+    g = build_forest()
+    nb, cells = g.nb, g.nb * g.bs ** 3
+    print(f"blocks={nb} cells={cells}")
+    tab = g.face_tables(1)
+    ftab = build_flux_tables(g)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((nb, 8, 8, 8)).astype(np.float32))
+    h2 = jnp.asarray((g.h ** 2).reshape(nb, 1, 1, 1), jnp.float32)
+
+    parts = {}
+    parts["assemble"] = timed(
+        chain(lambda v, t: t.assemble_scalar(v, 8)[:, 1:-1, 1:-1, 1:-1]),
+        x, tab)
+    parts["lap_noflux"] = timed(
+        chain(lambda v, t: amr_ops.laplacian_blocks(g, v, t, None)), x, tab)
+    parts["lap_reflux"] = timed(
+        chain(lambda v, t, ft: amr_ops.laplacian_blocks(g, v, t, ft)),
+        x, tab, ftab)
+    parts["getz"] = timed(chain(lambda v: krylov.getz_blocks(-h2 * v)), x)
+    parts["axpy"] = timed(chain(lambda v: v + 0.5 * v), x)
+
+    def dots(v):
+        d = jnp.sum(v * v, dtype=jnp.float32)
+        return v * (1.0 + 0.0 * d)
+    parts["dot+bcast"] = timed(chain(dots), x)
+
+    for k, v in parts.items():
+        print(f"{k:12s} {v*1e3:8.4f} ms")
+
+    it = 2 * parts["lap_reflux"] + 2 * parts["getz"] + 2 * parts["assemble"]
+    print(f"model 2(lap+getz): {it*1e3:.4f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Primitive cost model on the tunneled TPU, measured INSIDE a compiled
+while_loop by (k=25 - k=5)/20 differencing — the same regime the production
+BiCGSTAB runs in.  Used to decide the round-4 fusion strategy (VERDICT r4
+item 1): is the AMR iteration op-count-bound, gather-bound, or
+scatter-bound?
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python validation/prof_xla_prims.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NB = 904
+BS = 8
+
+
+def loop(f, k):
+    """while_loop applying f k times (data-dependent chain)."""
+    def run(x, *args):
+        def cond(c):
+            return c[0] < k
+        def body(c):
+            i, v = c
+            return (i + 1, f(v, *args))
+        return jax.lax.while_loop(cond, body, (jnp.int32(0), x))[1]
+    return jax.jit(run)
+
+
+def timed(f, *args, n=6):
+    r = f(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n
+
+
+def per_iter(f, *args):
+    t5 = timed(loop(f, 5), *args)
+    t25 = timed(loop(f, 25), *args)
+    return (t25 - t5) / 20.0
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((NB, BS, BS, BS)).astype(np.float32))
+    src = jnp.asarray(rng.integers(0, NB, NB).astype(np.int32))
+    src6 = jnp.asarray(rng.integers(0, NB, (6, NB)).astype(np.int32))
+    cell_idx = jnp.asarray(
+        rng.integers(0, NB * BS**3, 19000).astype(np.int32))
+    cell_val = jnp.asarray(rng.standard_normal(19000).astype(np.float32))
+    W = jnp.asarray(rng.standard_normal((512, 512)).astype(np.float32) / 512)
+    S3 = W
+    res = {}
+
+    res["axpy x1"] = per_iter(lambda v: v + 0.5 * v, x)
+    res["axpy x8 (fused?)"] = per_iter(
+        lambda v: ((((((((v * 1.01 + 0.1) * 0.99 - 0.1) * 1.02 + 0.05)
+                      * 0.98) + 0.02) * 1.01) - 0.01) * 0.995), x)
+    res["dot"] = per_iter(
+        lambda v: v * (1.0 + 0.0 * jnp.sum(v * v, dtype=jnp.float32)), x)
+    res["stencil7"] = per_iter(
+        lambda v: (jnp.pad(v, [(0, 0)] + [(1, 1)] * 3)[:, 2:, 1:-1, 1:-1]
+                   + jnp.pad(v, [(0, 0)] + [(1, 1)] * 3)[:, :-2, 1:-1, 1:-1]
+                   - 2.0 * v), x)
+    res["gather blocks x1"] = per_iter(
+        lambda v, s: jnp.take(v, s, axis=0), x, src)
+    res["gather blocks x6"] = per_iter(
+        lambda v, s: sum(jnp.take(v, s[f], axis=0) for f in range(6)),
+        x, src6)
+    res["gather planes x6"] = per_iter(
+        lambda v, s: v + sum(
+            jnp.take(v[:, 0], s[f], axis=0) for f in range(6))[:, None],
+        x, src6)
+    res["dus face add"] = per_iter(
+        lambda v: v.at[:, 0].add(v[:, 1] * 0.5), x)
+    res["scatter 19k cells"] = per_iter(
+        lambda v: v.reshape(-1).at[cell_idx].add(cell_val).reshape(v.shape),
+        x)
+    res["matmul W HIGHEST"] = per_iter(
+        lambda v: jax.lax.dot(
+            v.reshape(NB, 512), W,
+            precision=jax.lax.Precision.HIGHEST).reshape(v.shape), x)
+    res["matmul W DEFAULT"] = per_iter(
+        lambda v: jax.lax.dot(
+            v.reshape(NB, 512), W,
+            precision=jax.lax.Precision.DEFAULT).reshape(v.shape), x)
+    res["matmul split HI"] = per_iter(
+        lambda v: jax.lax.dot(
+            jax.lax.dot(v.reshape(NB, 512), S3,
+                        precision=jax.lax.Precision.HIGHEST) * 0.5,
+            S3, precision=jax.lax.Precision.HIGHEST).reshape(v.shape), x)
+    res["concat+gather"] = per_iter(
+        lambda v, s: jnp.take(
+            jnp.concatenate([v, jnp.zeros((1, BS, BS, BS), v.dtype)]),
+            s, axis=0), x, src)
+
+    for k, v in res.items():
+        print(f"{k:22s} {v*1e6:9.1f} us")
+
+
+if __name__ == "__main__":
+    main()
