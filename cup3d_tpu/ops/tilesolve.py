@@ -74,14 +74,18 @@ def tile_solve_blocks(b: jnp.ndarray, shift=None) -> jnp.ndarray:
     n = int(np.prod(lead)) if lead else 1
     S3, lam3, W = _basis(bs, b.dtype.name)
     b2 = b.reshape(n, bs ** 3)
+    # always the split form: measured in-loop on the axon TPU, ONE
+    # (n,512)x(512,512) HIGHEST matmul costs ~320us while the TWO split
+    # matmuls cost ~23us total (validation/prof_xla_prims.py) — the
+    # single-pass W form is never worth it
     if shift is None:
-        z = jax.lax.dot(b2, W, precision=_HI)  # W symmetric
+        sh = jnp.zeros((n, 1), b.dtype)
     else:
         sh = jnp.broadcast_to(jnp.asarray(shift, b.dtype),
                               lead + (1, 1, 1)).reshape(n, 1)
-        t = jax.lax.dot(b2, S3, precision=_HI)  # S3 symmetric: rows @ S3
-        t = t / (lam3[None, :] + sh)
-        z = jax.lax.dot(t, S3, precision=_HI)
+    t = jax.lax.dot(b2, S3, precision=_HI)  # S3 symmetric: rows @ S3
+    t = t / (lam3[None, :] + sh)
+    z = jax.lax.dot(t, S3, precision=_HI)
     return z.reshape(b.shape)
 
 
@@ -95,11 +99,12 @@ def tile_solve_lanes(bt: jnp.ndarray, shift=None) -> jnp.ndarray:
     T = bt.shape[-1]
     S3, lam3, W = _basis(bs, bt.dtype.name)
     b2 = bt.reshape(bs ** 3, T)
+    # split form always — see tile_solve_blocks
     if shift is None:
-        z = jax.lax.dot(W, b2, precision=_HI)
+        sh = jnp.zeros((1, T), bt.dtype)
     else:
         sh = jnp.broadcast_to(jnp.asarray(shift, bt.dtype), (1, T))
-        t = jax.lax.dot(S3, b2, precision=_HI)
-        t = t / (lam3[:, None] + sh)
-        z = jax.lax.dot(S3, t, precision=_HI)
+    t = jax.lax.dot(S3, b2, precision=_HI)
+    t = t / (lam3[:, None] + sh)
+    z = jax.lax.dot(S3, t, precision=_HI)
     return z.reshape(bt.shape)
