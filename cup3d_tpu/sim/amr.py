@@ -59,18 +59,10 @@ ADAPT_EVERY = 20  # reference cadence (main.cpp:15314)
 _EPS = 1e-6
 
 
-@jax.jit
-def _dt_device_update(umax, cfl, hmin, dif_cap):
-    """dt = min(CFL h/|u|max, diffusive cap), all on device."""
-    return jnp.minimum(cfl * hmin / jnp.maximum(umax, 1e-12), dif_cap)
-
-
-@jax.jit
-def _dt_device_update_implicit(umax, cfl, hmin, dif_cap, floor_u):
-    """Implicit diffusion: the explicit cap applies only while no velocity
-    scale exists (sim/simulation.py calc_max_timestep)."""
-    cap = jnp.where(jnp.maximum(umax, floor_u) > 1e-8, jnp.inf, dif_cap)
-    return jnp.minimum(cfl * hmin / jnp.maximum(umax, 1e-12), cap)
+from cup3d_tpu.sim.dtpolicy import (  # noqa: E402 (placed with jit helpers)
+    dt_device as _dt_device_update,
+    dt_device_implicit as _dt_device_update_implicit,
+)
 
 
 @partial(jax.jit, static_argnames=("combine", "bs"))
@@ -861,26 +853,22 @@ class AMRSimulation:
             raise RuntimeError(f"runaway velocity: max|u|={um:.3g}")
         if self._umax_dev is None:
             self._umax_dev = self._maxu(self.state["vel"], self.uinf_device())
-        cfl = cfg.CFL
-        if self.step_idx < cfg.rampup:
-            cfl = cfg.CFL * 10.0 ** (
-                -2.0 * (1.0 - self.step_idx / cfg.rampup)
-            )
+        from cup3d_tpu.sim import dtpolicy
+
+        cfl = dtpolicy.ramped_cfl(cfg.CFL, self.step_idx, cfg.rampup)
         hmin = float(self.grid.h.min())
         if cfg.implicitDiffusion:
-            # host policy: diffusive cap only while no velocity scale
-            floor_u = max(cfg.uMax_forced, float(np.abs(self.uinf).max()))
             dt = _dt_device_update_implicit(
                 self._umax_dev, jnp.asarray(cfl, self.dtype),
                 jnp.asarray(hmin, self.dtype),
-                jnp.asarray(0.25 * hmin * hmin / self.nu, self.dtype),
-                jnp.asarray(floor_u, self.dtype),
+                jnp.asarray(self.nu, self.dtype),
+                jnp.asarray(self.step_idx > 10),
             )
         else:
             dt = _dt_device_update(
                 self._umax_dev, jnp.asarray(cfl, self.dtype),
                 jnp.asarray(hmin, self.dtype),
-                jnp.asarray(0.25 * hmin * hmin / self.nu, self.dtype),
+                jnp.asarray(self.nu, self.dtype),
             )
         self.dt = dt
         if cfg.DLM > 0:
@@ -923,28 +911,19 @@ class AMRSimulation:
         if cfg.dt > 0:
             self.dt = cfg.dt
         else:
-            cfl = cfg.CFL
-            if self.step_idx < cfg.rampup:
-                cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - self.step_idx / cfg.rampup))
+            from cup3d_tpu.sim import dtpolicy
+
             prev_dt = self.dt
             if cfg.pipelined:
                 # stale-umax margin: see sim/simulation.py calc_max_timestep
                 umax = 1.5 * umax
-            dt_adv = cfl * hmin / max(umax, 1e-12)
+            # reference combined advection-diffusion cap + 1e-3 CFL ramp
+            # (main.cpp:15268-15281 via sim/dtpolicy.py)
+            self.dt = dtpolicy.dt_host(hmin, self.nu, umax, cfg.CFL,
+                                       self.step_idx, cfg.rampup,
+                                       cfg.implicitDiffusion)
             if cfg.pipelined and prev_dt > 0:
-                dt_adv = min(dt_adv, 1.03 * prev_dt)
-            if cfg.implicitDiffusion:
-                # keep the explicit cap while no velocity scale exists (see
-                # sim/simulation.py calc_max_timestep)
-                umax_eff = max(
-                    umax, cfg.uMax_forced, float(np.abs(self.uinf).max())
-                )
-                dt_dif = (
-                    np.inf if umax_eff > 1e-8 else 0.25 * hmin * hmin / self.nu
-                )
-            else:
-                dt_dif = 0.25 * hmin * hmin / self.nu
-            self.dt = float(min(dt_adv, dt_dif))
+                self.dt = min(self.dt, 1.03 * prev_dt)
             if cfg.tend > 0:
                 self.dt = min(self.dt, cfg.tend - self.time)
         if cfg.DLM > 0:

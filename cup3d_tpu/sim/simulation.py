@@ -144,9 +144,8 @@ class Simulation:
         if cfg.dt > 0:
             s.dt = cfg.dt
         else:
-            cfl = cfg.CFL
-            if s.step < cfg.rampup:  # logarithmic ramp 1e-2*CFL -> CFL
-                cfl = cfg.CFL * 10.0 ** (-2.0 * (1.0 - s.step / cfg.rampup))
+            from cup3d_tpu.sim import dtpolicy
+
             prev_dt = s.dt
             if cfg.pipelined:
                 # max|u| may be (1 + max_inflight) * read_every ~ 12 steps
@@ -157,17 +156,14 @@ class Simulation:
                 # sharp-chi fish at full gait measurably blows up without
                 # this margin while the fresh-umax host path is stable
                 umax = 1.5 * umax
-            dt_adv = cfl * h / max(umax, 1e-12)
+            # reference dt = min(combined diffusion cap, ramped CFL * h/umax)
+            # (main.cpp:15268-15281 via sim/dtpolicy.py — the combined cap
+            # is the upwind3 stability boundary; the pure 0.25 h^2/nu cap
+            # blew up the 256^3 fish, see dtpolicy docstring)
+            s.dt = dtpolicy.dt_host(h, s.nu, umax, cfg.CFL, s.step,
+                                    cfg.rampup, cfg.implicitDiffusion)
             if cfg.pipelined and prev_dt > 0:
-                dt_adv = min(dt_adv, 1.03 * prev_dt)
-            if cfg.implicitDiffusion:
-                # a from-rest flow is diffusion-dominated: keep the explicit
-                # cap until any velocity scale exists, else dt_adv blows up
-                umax_eff = max(umax, cfg.uMax_forced, float(np.abs(s.uinf).max()))
-                dt_dif = np.inf if umax_eff > 1e-8 else 0.25 * h * h / s.nu
-            else:
-                dt_dif = 0.25 * h * h / s.nu
-            s.dt = float(min(dt_adv, dt_dif))
+                s.dt = min(s.dt, 1.03 * prev_dt)
             if cfg.tend > 0:
                 s.dt = min(s.dt, cfg.tend - s.time)
         # lambda = DLM/dt each step (main.cpp:15302-15303)
