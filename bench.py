@@ -44,6 +44,23 @@ import numpy as np
 # baseline.jsonl) = a perfectly-scaled 64-rank run
 BASELINE_CELLS_PER_SEC = 64 * 5.24e5
 
+# per-config |div u| gates in the fluid region, ~2x the round-5 measured
+# values (fish128 ~0.017, fish256 ~0.034, two_fish_amr ~0.0017; VERDICT
+# r5 weak #9) — a 4x divergence regression now FAILS the bench, where the
+# old flat 0.15 gate let up to ~9x through.  Keyed by (config, n).
+DIV_FLUID_GATES = {
+    ("fish", 128): 0.04,
+    ("fish", 256): 0.07,
+    # two_fish_amr dynamics vary with CUP3D_BENCH_AMR_LEVELS; 0.01 is ~6x
+    # the round-5 level-4 value and still 15x tighter than the old gate
+    ("two_fish_amr", None): 0.01,
+}
+
+
+def _div_gate(config: str, n=None, default: float = 0.15) -> float:
+    return DIV_FLUID_GATES.get((config, n),
+                               DIV_FLUID_GATES.get((config, None), default))
+
 
 def _scaled(n_default: int) -> int:
     n = int(os.environ.get("CUP3D_BENCH_N", "0"))
@@ -151,10 +168,12 @@ def bench_fish_uniform(n_default: int = 128):
         sim.advance(sim.calc_max_timestep())
     sim.sim.profiler.totals.clear()
     sim.sim.profiler.counts.clear()
+    sim._pack_reader.reset_stats()  # stream counters cover the timed window
     wall, wall_mean, wall_max = _time_steps_robust(
         sim.advance, sim.calc_max_timestep, warmup=0, iters=iters,
         tag="fish",
     )
+    stream = sim._pack_reader.snapshot()
     sim.flush_packs()
     cells_s = n**3 / wall
 
@@ -178,6 +197,11 @@ def bench_fish_uniform(n_default: int = 128):
                  / max(sim.sim.profiler.counts[k], 1), 4)
         for k in sim.sim.profiler.totals
     }
+    # StreamWait fires per backpressure EVENT, not per step: normalize the
+    # total over the timed window to a per-step figure
+    stream_wait_per_step = (
+        sim.sim.profiler.totals.get("StreamWait", 0.0) / iters
+    )
 
     # BiCGSTAB microbenchmark on the production pressure system: advance
     # the pipeline up to (but excluding) PressureProjection so the rhs is
@@ -231,6 +255,7 @@ def bench_fish_uniform(n_default: int = 128):
     _, _, k_warm = solve(rhs, p_prev)
     k_warm = int(k_warm)
 
+    gate = _div_gate("fish", n)
     return {
         "cells_per_s": cells_s,
         "wall_per_step_s": round(wall, 4),
@@ -238,10 +263,22 @@ def bench_fish_uniform(n_default: int = 128):
         "wall_per_step_max_s": round(wall_max, 4),
         "div_max": float(div_max),
         "div_max_fluid": float(div_fluid),
-        "div_fluid_gate_ok": bool(float(div_fluid) < 0.15),
+        "div_fluid_gate": gate,
+        "div_fluid_gate_ok": bool(float(div_fluid) < gate),
         "bicgstab_iters_to_tol": int(k_cold),
         "bicgstab_iters_warm_restart": k_warm,
         "bicgstab_iters_per_s": round(int(k2) / max(t_cold, 1e-9), 1),
+        # stream/qoi.py counters over the timed window: SyncQoI is the
+        # host work of emitting/consuming packs; the device catch-up wait
+        # is attributed to StreamWait (= stream_stall_s), so host-read
+        # cost no longer hides inside SyncQoI (VERDICT r5, fish256)
+        "sync_qoi_s": round(prof.get("SyncQoI", 0.0), 4),
+        "stream_wait_s": round(stream_wait_per_step, 4),
+        "stream_bytes": int(stream["bytes_streamed"]
+                            + stream["bytes_staged"]),
+        "stream_stall_s": round(stream["stall_s"], 4),
+        "stream": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in stream.items()},
         "roofline": _lanes_roofline(A, M, rhs),
         "per_operator_mean_s": prof,
         "n": n,
@@ -464,6 +501,7 @@ def bench_amr_tgv():
         sim.advance, sim.calc_max_timestep, warmup=10, iters=iters,
         tag="amr_tgv",
     )
+    stream = sim._pack_reader.snapshot()
     total, div_max = sim._divnorms(sim.state["vel"])
     nb = sim.grid.nb
     out = {
@@ -474,6 +512,9 @@ def bench_amr_tgv():
         "blocks": int(nb),
         "levels": sorted(set(int(l) for l in np.asarray(sim.grid.level))),
         "div_max": float(div_max),
+        "stream_bytes": int(stream["bytes_streamed"]
+                            + stream["bytes_staged"]),
+        "stream_stall_s": round(stream["stall_s"], 4),
     }
     out["roofline"] = _amr_roofline(sim)
     return out
@@ -576,6 +617,7 @@ def bench_two_fish_amr():
         sim.advance, sim.calc_max_timestep, warmup=25, iters=iters,
         tag="two_fish_amr",
     )
+    stream = sim._pack_reader.snapshot()
     sim.flush_packs()
     total, div_max = sim._divnorms(sim.state["vel"])
     from cup3d_tpu.ops.diagnostics import fluid_divergence_max_blocks
@@ -584,6 +626,7 @@ def bench_two_fish_amr():
         sim.grid, sim.state["vel"], sim.state["chi"], sim._tab1
     )
     nb = sim.grid.nb
+    gate = _div_gate("two_fish_amr")
     return {
         "wall_per_step_s": round(med, 4),  # trimmed mean (see _time_steps_robust)
         "wall_per_step_mean_s": round(mean, 4),
@@ -593,6 +636,11 @@ def bench_two_fish_amr():
         "levels": level_max,
         "div_max": float(div_max),
         "div_max_fluid": float(div_fluid),
+        "div_fluid_gate": gate,
+        "div_fluid_gate_ok": bool(float(div_fluid) < gate),
+        "stream_bytes": int(stream["bytes_streamed"]
+                            + stream["bytes_staged"]),
+        "stream_stall_s": round(stream["stall_s"], 4),
     }
 
 
@@ -683,6 +731,45 @@ def main():
             d["n"] = print_n
         out[k] = d
     print(json.dumps(out))
+    # the LAST line is a compact single-line summary (headline metric +
+    # per-config cells/s + gates + stream counters only): the driver keeps
+    # a 2000-char tail, which the full record above overflows mid-JSON
+    # (VERDICT r5 weak #8, `parsed: null`) — the tail now always ends in
+    # one complete parseable object
+    print(json.dumps(_compact_summary(out)))
+
+
+def _compact_summary(out: dict) -> dict:
+    compact = {
+        "metric": out.get("metric"),
+        "value": out.get("value"),
+        "unit": out.get("unit"),
+        "vs_baseline": out.get("vs_baseline"),
+    }
+    cells, gates = {}, {}
+    for key, d in out.items():
+        if not isinstance(d, dict):
+            continue
+        if "error" in d:
+            compact.setdefault("errors", []).append(key)
+            continue
+        if "cells_per_s" in d:
+            cells[key] = round(float(d["cells_per_s"]), 1)
+        if "div_fluid_gate_ok" in d:
+            gates[key] = {
+                "div_fluid": round(float(d.get("div_max_fluid", 0.0)), 4),
+                "gate": d.get("div_fluid_gate"),
+                "ok": d["div_fluid_gate_ok"],
+            }
+        for k in ("sync_qoi_s", "stream_stall_s", "stream_bytes"):
+            if k in d:
+                compact.setdefault("stream", {}).setdefault(key, {})[k] = d[k]
+    if isinstance(out.get("fish"), dict):
+        # the headline config's rate lives in out["value"], not out["fish"]
+        cells["fish"] = round(float(out.get("value", 0.0)), 1)
+    compact["cells_per_s"] = cells
+    compact["gates"] = gates
+    return compact
 
 
 if __name__ == "__main__":

@@ -39,7 +39,12 @@ def checkpoint_path(directory: str, step: int) -> str:
     return os.path.join(directory, f"ckpt_{step:07d}.pkl")
 
 
-def save_checkpoint(driver, path: Optional[str] = None) -> str:
+def build_payload(driver) -> dict:
+    """Snapshot everything a checkpoint needs WITHOUT blocking on device
+    arrays: ``fields`` holds DEVICE references (immutable in jax, so
+    they stay valid snapshots while stepping continues); all host-side
+    state (scalars, octree keys, obstacles) is captured synchronously.
+    ``materialize_payload`` turns this into the on-disk format."""
     kind = _driver_kind(driver)
     if kind == "amr":
         state = {k: driver._unpad(v) for k, v in driver.state.items()}
@@ -56,12 +61,12 @@ def save_checkpoint(driver, path: Optional[str] = None) -> str:
         obstacles = s.obstacles
         leaves = None
         next_dump = s.cadence.next_dump
-    payload = {
+    return {
         "version": FORMAT_VERSION,
         "kind": kind,
         "cfg": dataclasses.asdict(driver.cfg),
         "leaves": leaves,
-        "fields": {k: np.asarray(v) for k, v in state.items()},
+        "fields": dict(state),
         "time": float(time),
         "step": int(step),
         "dt": float(dt),
@@ -70,12 +75,32 @@ def save_checkpoint(driver, path: Optional[str] = None) -> str:
         "next_dump": float(next_dump),
         "obstacles": obstacles,
     }
-    if path is None:
-        path = checkpoint_path(driver.cfg.path4serialization, int(step))
+
+
+def materialize_payload(payload: dict) -> dict:
+    """Resolve the device field references of ``build_payload`` to numpy
+    (blocking only until their async copies land)."""
+    out = dict(payload)
+    out["fields"] = {k: np.asarray(v) for k, v in payload["fields"].items()}
+    return out
+
+
+def write_payload(payload: dict, path: str) -> str:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "wb") as f:
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
     return path
+
+
+def save_checkpoint(driver, path: Optional[str] = None) -> str:
+    """Synchronous checkpoint (tools/tests; the drivers stream saves off
+    the step loop via stream/checkpoint.AsyncCheckpointer instead)."""
+    payload = build_payload(driver)
+    if path is None:
+        path = checkpoint_path(
+            driver.cfg.path4serialization, payload["step"]
+        )
+    return write_payload(materialize_payload(payload), path)
 
 
 def load_checkpoint(path: str, mesh=None):

@@ -15,6 +15,12 @@ unchanged on our output:
 The reference dumps only chi through MPI-IO collectives; here the dump is
 host-side numpy (fields come off-device once per ``tdump``), and multiple
 attributes (chi, velocity components, |omega|) share one geometry file.
+
+``dump_fields`` below is the single-writer reference implementation; the
+drivers write through ``stream/dump.py`` — a sharded multi-writer path
+(the single-host analogue of the reference's ``MPI_Exscan`` +
+``write_at_all``) that produces byte-identical files without blocking
+the step loop.
 """
 
 from __future__ import annotations
@@ -178,6 +184,22 @@ def collect_dump_fields(cfg, state, omega_fn=None) -> Dict[str, np.ndarray]:
         fields.update(velx=v[..., 0], vely=v[..., 1], velz=v[..., 2])
     if cfg.dumpOmega and omega_fn is not None:
         fields["omega"] = np.asarray(omega_fn(state["vel"]))
+    return fields
+
+
+def collect_dump_fields_device(cfg, state, omega_fn=None) -> Dict[str, object]:
+    """DEVICE-side twin of ``collect_dump_fields``: same flag logic, but
+    every value stays a device array (component slices and |curl u| are
+    device ops), so the drivers can hand the set to the async staged dump
+    (stream/dump.AsyncDumper) without a blocking host read."""
+    fields: Dict[str, object] = {}
+    if cfg.dumpChi:
+        fields["chi"] = state["chi"]
+    if cfg.dumpVelocity:
+        v = state["vel"]
+        fields.update(velx=v[..., 0], vely=v[..., 1], velz=v[..., 2])
+    if cfg.dumpOmega and omega_fn is not None:
+        fields["omega"] = omega_fn(state["vel"])
     return fields
 
 

@@ -39,20 +39,34 @@ class BufferedLogger:
 
 
 class Profiler:
-    """Accumulates wall-clock per named section; `report()` returns a table."""
+    """Accumulates wall-clock per named section; `report()` returns a table.
+
+    Sections record SELF time: when sections nest, the inner section's
+    wall is excluded from the outer one, so section totals partition the
+    measured wall instead of double-counting.  The load-bearing case is
+    the stream's ``StreamWait`` (device-catch-up backpressure) opening
+    inside the drivers' ``SyncQoI`` — SyncQoI then measures the actual
+    host work of a packed read, not the device time it used to hide
+    (stream/qoi.py, VERDICT r5 fish256)."""
 
     def __init__(self):
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        self._stack: List[float] = []  # per-open-section child-time sums
 
     @contextmanager
     def __call__(self, name: str):
         t0 = time.perf_counter()
+        self._stack.append(0.0)
         try:
             yield
         finally:
-            self.totals[name] += time.perf_counter() - t0
+            elapsed = time.perf_counter() - t0
+            child = self._stack.pop()
+            self.totals[name] += elapsed - child
             self.counts[name] += 1
+            if self._stack:
+                self._stack[-1] += elapsed
 
     def report(self) -> str:
         total = sum(self.totals.values()) or 1.0

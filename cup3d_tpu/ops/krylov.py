@@ -399,15 +399,24 @@ def _make_coarse_solve_vec(grid: UniformGrid, bs: int = 8) -> Callable:
     Vs, lams = [], []
     for ax in range(3):
         n = nb[ax]
-        L = 2.0 * np.eye(n) - np.diag(np.ones(n - 1), 1) - np.diag(
-            np.ones(n - 1), -1
-        )
-        if grid.bc[ax] == BC.periodic and n > 1:
-            L[0, -1] -= 1.0
-            L[-1, 0] -= 1.0
-        else:  # zero-gradient: no coupling through the wall
-            L[0, 0] = 1.0
-            L[-1, -1] = 1.0
+        if n == 1:
+            # degenerate axis: a single tile has no coarse neighbor in
+            # either BC family (the periodic wrap is itself, the Neumann
+            # wall is zero-gradient), so the exact Galerkin P^T A P row is
+            # 0 — an isolated node whose constant mode the pseudo-inverse
+            # below projects out (ADVICE r5: the wall branch's diagonal 1
+            # added a spurious bs^2/h^2 eigenvalue shift here)
+            L = np.zeros((1, 1))
+        else:
+            L = 2.0 * np.eye(n) - np.diag(np.ones(n - 1), 1) - np.diag(
+                np.ones(n - 1), -1
+            )
+            if grid.bc[ax] == BC.periodic:
+                L[0, -1] -= 1.0
+                L[-1, 0] -= 1.0
+            else:  # zero-gradient: no coupling through the wall
+                L[0, 0] = 1.0
+                L[-1, -1] = 1.0
         w, V = np.linalg.eigh(L)
         Vs.append(V)
         lams.append(w)

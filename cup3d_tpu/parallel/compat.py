@@ -1,0 +1,38 @@
+"""jax version compatibility for the sharded-forest layer.
+
+``jax.shard_map`` (with ``check_vma``) became a top-level API after the
+experimental ``jax.experimental.shard_map.shard_map`` (with
+``check_rep``) stabilized.  The TPU image runs the new API; CPU test
+environments may carry an older jax where only the experimental path
+exists.  The wrapper keeps one call surface (the new API's) for the
+forest/faces kernels and maps the replication-check flag across.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _has_new_api() -> bool:
+    try:
+        return callable(jax.shard_map)
+    except AttributeError:
+        return False
+
+
+if _has_new_api():
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

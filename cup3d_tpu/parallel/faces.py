@@ -42,6 +42,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from cup3d_tpu.grid.faces import FaceTables, _place, _restrict8, _slab
+from cup3d_tpu.parallel.compat import shard_map
 
 __all__ = ["ShardedFaceTables", "build_sharded_face_tables"]
 
@@ -271,7 +272,7 @@ class ShardedFaceTables:
             (self.cf_rows[fc], self.cf_src[fc], self.cf_toff[fc])
             for fc in range(6)
         )
-        return jax.shard_map(
+        return shard_map(
             kernel,
             mesh=f.mesh,
             in_specs=(pb, pb, pb, jax.tree_util.tree_map(

@@ -52,6 +52,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cup3d_tpu.grid.blocks import BlockGrid, LabTables
 from cup3d_tpu.grid.flux import FluxTables, build_flux_tables
+from cup3d_tpu.parallel.compat import shard_map
 
 _HI = jax.lax.Precision.HIGHEST
 
@@ -202,7 +203,7 @@ class ShardedLabTables:
             return lab.at[:, gx, gy, gz].set(ghosts.astype(field.dtype))
 
         pb = P(f.axis)
-        return jax.shard_map(
+        return shard_map(
             kernel,
             mesh=f.mesh,
             in_specs=(pb,) * 9,
@@ -267,7 +268,7 @@ class ShardedFluxTables:
             return flat.reshape(out.shape)
 
         pb = P(f.axis)
-        return jax.shard_map(
+        return shard_map(
             kernel,
             mesh=f.mesh,
             in_specs=(pb,) * 7,

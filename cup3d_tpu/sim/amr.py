@@ -144,13 +144,25 @@ class AMRSimulation:
         # re-layout, no recompiles (BASELINE config #3 is a static 2-level
         # run; dynamic runs leave this True)
         self.adapt_enabled = True
-        # pipelined fast path (cfg.pipelined): pack queue + reader thread
-        # (the uniform driver's depth-2 scheme, sim/simulation.py), plus a
-        # collision fallback latch that reroutes to the host path while any
-        # stale overlap pre-check is non-zero
-        from cup3d_tpu.sim.pack import GroupedPackReader
+        # pipelined fast path (cfg.pipelined): grouped deferred reads
+        # through the async host data-plane (stream/qoi.py; the uniform
+        # driver's depth-2 scheme), plus a collision fallback latch that
+        # reroutes to the host path while any stale overlap pre-check is
+        # non-zero.  The pack policy slims 256^3-class configs to
+        # scalars-only; every emitted pack here already is.
+        from cup3d_tpu.stream.qoi import PackPolicy, QoIStream
 
-        self._pack_reader = GroupedPackReader(self._consume_entry)
+        self._pack_reader = QoIStream(
+            self._consume_entry,
+            policy=PackPolicy.for_cells(self.grid.nb * self.grid.bs**3),
+            profiler=self.profiler,
+        )
+        # off-critical-path output (stream/dump.py, stream/checkpoint.py)
+        from cup3d_tpu.stream.checkpoint import AsyncCheckpointer
+        from cup3d_tpu.stream.dump import AsyncDumper
+
+        self._dumper = AsyncDumper()
+        self._checkpointer = AsyncCheckpointer()
         self._uinf_dev = None
         self._collision_hot = False
         # refinement scores dispatched one step EARLY in pipelined mode so
@@ -937,11 +949,11 @@ class AMRSimulation:
             self.flush_packs()  # host mirrors current before output
             self.dump_fields()
         if self._cadence.save_due(self.step_idx):
-            from cup3d_tpu.io.checkpoint import save_checkpoint
-
             self.flush_packs()
             with self.profiler("Checkpoint"):
-                save_checkpoint(self)
+                # async snapshot: fields stage via copy_to_host_async and
+                # serialize on the writer thread (stream/checkpoint.py)
+                self._checkpointer.save(self)
 
     def dump_fields(self):
         import os
@@ -949,18 +961,26 @@ class AMRSimulation:
         from cup3d_tpu.io import dump as dmp
 
         state_view = {k: self._unpad(v) for k, v in self.state.items()}
-        fields = dmp.collect_dump_fields(
+        fields = dmp.collect_dump_fields_device(
             self.cfg, state_view,
-            lambda _vel: np.asarray(
-                self._unpad(self._omega_mag(self.state["vel"]))
-            ),
+            lambda _vel: self._unpad(self._omega_mag(self.state["vel"])),
         )
         if fields:
             prefix = os.path.join(
                 self.cfg.path4serialization, f"dump_{self.step_idx:07d}"
             )
             with self.profiler("Dump"):
-                dmp.dump_fields(prefix, self.time, self.grid, fields)
+                # async staged handoff: the sharded multi-writer runs off
+                # the step loop (stream/dump.py).  The grid object handed
+                # over is this step's layout — adaptation replaces, never
+                # mutates, the BlockGrid, so the snapshot stays coherent.
+                self._dumper.submit(prefix, self.time, self.grid, fields)
+
+    def drain_streams(self):
+        """Join all off-critical-path output (pending dumps/checkpoints) —
+        run end, and anything that must observe the files on disk."""
+        self._dumper.wait()
+        self._checkpointer.wait()
 
     def advance(self, dt: float):
         if self.cfg.pipelined and not self._collision_hot:
@@ -1195,14 +1215,11 @@ class AMRSimulation:
             ):
                 # dispatch next step's refinement scores now: the compute
                 # and transfer overlap this step's pack read + host work
+                # (staged through the stream so its bytes are counted)
                 vort, near = self._scores(s["vel"], s["chi"])
-                packed = jnp.concatenate(
+                packed = self._pack_reader.stage(jnp.concatenate(
                     [vort.astype(self.dtype), near.astype(self.dtype)]
-                )
-                try:
-                    packed.copy_to_host_async()
-                except Exception:
-                    pass
+                ))
                 self._scores_prefetch = (packed, self.grid.nb)
         freq = self.cfg.freqDiagnostics
         if freq > 0 and self.step_idx % freq == 0:
@@ -1282,13 +1299,9 @@ class AMRSimulation:
             nxt = self.step_idx + 1
             if self.adapt_enabled and (nxt < 10 or nxt % ADAPT_EVERY == 0):
                 vort, near = self._scores(s["vel"], s["chi"])
-                packed = jnp.concatenate(
+                packed = self._pack_reader.stage(jnp.concatenate(
                     [vort.astype(self.dtype), near.astype(self.dtype)]
-                )
-                try:
-                    packed.copy_to_host_async()
-                except Exception:
-                    pass
+                ))
                 self._scores_prefetch = (packed, self.grid.nb)
         freq = self.cfg.freqDiagnostics
         if freq > 0 and self.step_idx % freq == 0:
@@ -1452,4 +1465,5 @@ class AMRSimulation:
             if done_t or done_n:
                 break
         self.flush_packs()
+        self.drain_streams()
         self.logger.flush()

@@ -29,13 +29,24 @@ class Simulation:
         # max|u| fetched in the previous step's packed read (fast path):
         # saves the blocking read at the top of calc_max_timestep
         self._umax_next: float | None = None
-        # pipelined mode: grouped deferred reads (sim/pack.py) — K packs
-        # concatenate on device into ONE worker-thread fetch, amortizing
-        # the tunnel's per-read latency; non-pipelined runs consume each
-        # pack at the end of its own step
-        from cup3d_tpu.sim.pack import GroupedPackReader
+        # pipelined mode: grouped deferred reads through the async host
+        # data-plane (stream/qoi.py) — K packs concatenate on device into
+        # ONE async fetch, amortizing the tunnel's per-read latency;
+        # non-pipelined runs consume each pack at the end of its own step.
+        # The pack policy slims 256^3-class configs to scalars-only.
+        from cup3d_tpu.stream.qoi import PackPolicy, QoIStream
 
-        self._pack_reader = GroupedPackReader(self._consume_pack)
+        ncells = int(np.prod(self.sim.grid.shape))
+        self._pack_reader = QoIStream(
+            self._consume_pack, policy=PackPolicy.for_cells(ncells),
+            profiler=self.sim.profiler,
+        )
+        # off-critical-path output (stream/dump.py, stream/checkpoint.py)
+        from cup3d_tpu.stream.checkpoint import AsyncCheckpointer
+        from cup3d_tpu.stream.dump import AsyncDumper
+
+        self._dumper = AsyncDumper()
+        self._checkpointer = AsyncCheckpointer()
 
     # -- setup (reference init(), main.cpp:15163-15178) --------------------
 
@@ -179,28 +190,38 @@ class Simulation:
             self.flush_packs()  # host mirrors current before output
             self.dump_fields()
         if s.cadence.save_due(s.step):
-            from cup3d_tpu.io.checkpoint import save_checkpoint
-
             self.flush_packs()
             with s.profiler("Checkpoint"):
-                save_checkpoint(self)
+                # async snapshot: fields stage via copy_to_host_async and
+                # serialize on the writer thread (stream/checkpoint.py)
+                self._checkpointer.save(self)
 
     def dump_fields(self) -> None:
         import os
+
+        import jax.numpy as jnp
 
         from cup3d_tpu.io import dump as dmp
 
         s, cfg = self.sim, self.cfg
 
         def omega_mag(vel):
-            om = np.asarray(diag.vorticity(s.grid, vel))
-            return np.sqrt(np.sum(om**2, axis=-1))
+            om = diag.vorticity(s.grid, vel)
+            return jnp.sqrt(jnp.sum(om * om, axis=-1))
 
-        fields = dmp.collect_dump_fields(cfg, s.state, omega_mag)
+        fields = dmp.collect_dump_fields_device(cfg, s.state, omega_mag)
         if fields:
             prefix = os.path.join(cfg.path4serialization, f"dump_{s.step:07d}")
             with s.profiler("Dump"):
-                dmp.dump_fields(prefix, s.time, s.grid, fields)
+                # async staged handoff: the sharded multi-writer runs off
+                # the step loop (stream/dump.py)
+                self._dumper.submit(prefix, s.time, s.grid, fields)
+
+    def drain_streams(self) -> None:
+        """Join all off-critical-path output (pending dumps/checkpoints) —
+        run end, and anything that must observe the files on disk."""
+        self._dumper.wait()
+        self._checkpointer.wait()
 
     def advance(self, dt: float) -> None:
         s = self.sim
@@ -245,17 +266,10 @@ class Simulation:
                 umax_dev, jnp.max(jnp.abs(s.state["udef"]))
             )
         parts.append(("umax", umax_dev.reshape(1)))
-        # pack in the solver dtype: a forced f32 cast would silently
-        # truncate the rigid trajectory in a float64 configuration
-        pack = jnp.concatenate([p[1].astype(s.dtype) for p in parts])
-        try:
-            pack.copy_to_host_async()
-        except Exception:
-            pass  # experimental platforms may lack async copies
-        return {
-            "layout": [(n, a.shape[0]) for n, a in parts], "pack": pack,
-            "time": s.time,
-        }
+        # pack in the solver dtype (a forced f32 cast would silently
+        # truncate the rigid trajectory in a float64 configuration); the
+        # stream applies its slimming policy before the device concat
+        return self._pack_reader.pack_parts(parts, s.dtype, time=s.time)
 
     def _consume_pack(self, entry: dict) -> None:
         """Read one emitted pack (or reuse the worker's fetch) and refresh
@@ -303,4 +317,5 @@ class Simulation:
             if done_t or done_n:
                 break
         self.flush_packs()
+        self.drain_streams()
         s.logger.flush()

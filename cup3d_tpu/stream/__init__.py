@@ -1,0 +1,31 @@
+"""Async host data-plane: every device->host byte goes through here.
+
+The reference overlaps all host traffic with compute (QoI reductions ride
+MPI allreduces, snapshots go out via MPI-IO collectives with
+``MPI_Exscan``-computed offsets, main.cpp:429-553) so the solve never
+waits on the host.  This package is the port's equivalent, as a
+first-class subsystem instead of per-driver ad-hoc code:
+
+- :mod:`cup3d_tpu.stream.qoi` — streaming QoI reads: per-step packs are
+  grouped on device, copied with ``copy_to_host_async`` into a bounded
+  FIFO, and consumed strictly in order with per-stream counters (bytes,
+  groups in flight, stall seconds) and per-config pack slimming;
+- :mod:`cup3d_tpu.stream.dump` — sharded multi-writer field dumps (the
+  single-host analogue of ``MPI_Exscan`` + ``write_at_all``) with async
+  device->host staging so ``dump()`` never blocks the dispatch stream;
+- :mod:`cup3d_tpu.stream.checkpoint` — checkpoints snapshot device state
+  via async copies and serialize off the step loop, restore-compatible
+  with :mod:`cup3d_tpu.io.checkpoint` files.
+"""
+
+from cup3d_tpu.stream.qoi import PackPolicy, QoIStream
+from cup3d_tpu.stream.dump import AsyncDumper, dump_fields_sharded
+from cup3d_tpu.stream.checkpoint import AsyncCheckpointer
+
+__all__ = [
+    "QoIStream",
+    "PackPolicy",
+    "AsyncDumper",
+    "dump_fields_sharded",
+    "AsyncCheckpointer",
+]

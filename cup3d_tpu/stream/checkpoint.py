@@ -1,0 +1,93 @@
+"""Off-critical-path checkpoints: async device snapshots, background
+serialization, restore-compatible with ``io/checkpoint.py`` files.
+
+The reference parses ``-fsave/saveFreq`` but ships no restart
+serialization; ``io/checkpoint.py`` filled that gap with a synchronous
+pickle — a full blocking field read plus a serial write on the step
+loop.  Here the save splits into:
+
+1. **snapshot** (main thread, non-blocking): ``io.checkpoint
+   .build_payload`` captures device field REFERENCES (immutable, so the
+   snapshot stays consistent while stepping continues) and all host
+   scalars; obstacles are deep-frozen via a pickle round trip because
+   their host-side kinematic state keeps mutating; every field starts a
+   ``copy_to_host_async`` so the transfers overlap subsequent steps;
+2. **write** (background thread): materialize the landed copies and
+   pickle the exact ``io/checkpoint.py`` payload (same FORMAT_VERSION,
+   same keys), so ``io.checkpoint.load_checkpoint`` restores these
+   files unchanged.
+
+``max_pending`` bounds host memory: a save issued while the previous is
+still writing joins it first (checkpoints are rare; two in flight means
+the disk, not the solver, is the bottleneck).  ``wait()`` joins all
+pending writes and re-raises the first failure — drivers call it at run
+end, and anything that must read a checkpoint back calls it first.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from cup3d_tpu.io.checkpoint import (
+    build_payload,
+    checkpoint_path,
+    materialize_payload,
+    write_payload,
+)
+
+
+class AsyncCheckpointer:
+    def __init__(self, max_pending: int = 1):
+        self.max_pending = max_pending
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pending: List = []
+        self.stats = {"saves": 0, "snapshot_s": 0.0, "write_s": 0.0}
+
+    def save(self, driver, path: Optional[str] = None) -> str:
+        """Snapshot ``driver`` now; write in the background.  Returns the
+        checkpoint path (the file lands when the write job completes)."""
+        t0 = time.perf_counter()
+        payload = build_payload(driver)
+        # deep-freeze host-mutable obstacle state (device arrays and the
+        # sim backref are dropped by Obstacle.__getstate__ / restored on
+        # load, exactly as the synchronous path pickles them)
+        payload["obstacles"] = pickle.loads(
+            pickle.dumps(payload["obstacles"],
+                         protocol=pickle.HIGHEST_PROTOCOL)
+        )
+        for v in payload["fields"].values():
+            try:
+                v.copy_to_host_async()
+            except Exception:
+                pass  # numpy fields / platforms without async copies
+        if path is None:
+            path = checkpoint_path(
+                driver.cfg.path4serialization, payload["step"]
+            )
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                1, thread_name_prefix="cup3d-ckpt"
+            )
+        while len(self._pending) >= self.max_pending:
+            self._pending.pop(0).result()
+        self._pending.append(self._pool.submit(self._write, payload, path))
+        self.stats["saves"] += 1
+        self.stats["snapshot_s"] += time.perf_counter() - t0
+        return path
+
+    def _write(self, payload: dict, path: str) -> str:
+        t0 = time.perf_counter()
+        out = write_payload(materialize_payload(payload), path)
+        self.stats["write_s"] += time.perf_counter() - t0
+        return out
+
+    def wait(self) -> None:
+        pending, self._pending = self._pending, []
+        for fut in pending:
+            fut.result()
+
+    def __bool__(self):
+        return bool(self._pending)

@@ -1,0 +1,236 @@
+"""Sharded multi-writer field dumps with async device->host staging.
+
+The reference writes snapshots through collective MPI-IO: every rank
+computes its byte offset with ``MPI_Exscan`` and writes its own extent
+with ``MPI_File_write_at_all`` (main.cpp:429-553), so no single rank
+funnels the whole field.  ``io/dump.py`` inverted that — one writer
+serializes geometry + every attribute.  This module is the single-host
+analogue of the reference scheme:
+
+- the cell range is split into contiguous extents, one per shard;
+- byte offsets are an exclusive scan (``_exscan``) of the per-shard byte
+  counts — precomputed, so every shard writes independently;
+- shards write concurrently with ``os.pwrite`` into a preallocated file
+  (positional writes need no shared file pointer — the thread-pool twin
+  of ``write_at_all``), including their own slice of the 8-vertex
+  hexahedron geometry (computed per shard: the full vertex array at
+  256^3 is ~1.6 GB, which the single writer materialized at once);
+- one XDMF index per attribute is written by the coordinator, exactly
+  the single-writer format — output is byte-identical to
+  ``io.dump.dump_fields`` (asserted in tests/test_stream.py), so the
+  reference-style ``tools/post.py`` reader works unchanged.
+
+:class:`AsyncDumper` puts the whole thing off the critical path: fields
+are handed over as DEVICE arrays (immutable in jax, so snapshotting is
+reference-capture), ``copy_to_host_async`` starts their transfers, and a
+background writer thread materializes + shard-writes them while the step
+loop keeps dispatching.  ``dump()`` on the drivers is then a few
+microseconds of handoff instead of a blocking field read + serial write.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cup3d_tpu.io.dump import (
+    _CORNERS,
+    _XDMF,
+    _cell_geometry_blocks,
+    _cell_geometry_uniform,
+)
+
+
+def _auto_shards() -> int:
+    n = os.cpu_count() or 1
+    return max(1, min(8, n))
+
+
+def _extents(ncell: int, nshards: int) -> List[Tuple[int, int]]:
+    """Split [0, ncell) into <= nshards contiguous, near-equal extents."""
+    nshards = max(1, min(nshards, ncell)) if ncell else 1
+    bounds = np.linspace(0, ncell, nshards + 1, dtype=np.int64)
+    return [(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])
+            if b > a]
+
+
+def _exscan(counts: Sequence[int]) -> List[int]:
+    """Exclusive scan of byte counts -> per-shard file offsets (the
+    single-host MPI_Exscan)."""
+    out, acc = [], 0
+    for c in counts:
+        out.append(acc)
+        acc += int(c)
+    return out
+
+
+def _pwrite_extents(path: str, jobs: List[Tuple[int, "object"]],
+                    total_bytes: int, pool: Optional[ThreadPoolExecutor]):
+    """Preallocate ``path`` to ``total_bytes`` and write each (offset,
+    make_bytes) extent, concurrently when a pool is given.  Each shard
+    produces ITS OWN bytes inside its worker (callable jobs), so no
+    single thread materializes the whole file."""
+    with open(path, "wb") as f:
+        f.truncate(total_bytes)
+    fd = os.open(path, os.O_WRONLY)
+
+    def write_one(job):
+        off, make = job
+        os.pwrite(fd, make() if callable(make) else make, off)
+
+    try:
+        if pool is None:
+            for job in jobs:
+                write_one(job)
+        else:
+            list(pool.map(write_one, jobs))
+    finally:
+        os.close(fd)
+
+
+def cell_geometry(grid) -> Tuple[np.ndarray, np.ndarray]:
+    """grid -> per-cell (low corner (n,3), spacing (n,)), block-major for
+    BlockGrid, C-order for UniformGrid (shared with io/dump.py)."""
+    if hasattr(grid, "shape"):  # uniform
+        return _cell_geometry_uniform(grid)
+    return _cell_geometry_blocks(grid)
+
+
+def dump_fields_sharded(
+    prefix: str,
+    time_: float,
+    grid,
+    fields: Dict[str, np.ndarray],
+    nshards: int = 0,
+) -> dict:
+    """Sharded-writer twin of ``io.dump.dump_fields``: identical files
+    (same names, same bytes), written as concurrent per-extent
+    ``pwrite``s.  Returns {bytes_written, shards, files}."""
+    os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+    if nshards <= 0:
+        nshards = _auto_shards()
+    origin, h = cell_geometry(grid)
+    ncell = origin.shape[0]
+    extents = _extents(ncell, nshards)
+    pool = ThreadPoolExecutor(len(extents)) if len(extents) > 1 else None
+    bytes_written = 0
+    files = []
+    try:
+        # geometry: each shard expands ITS cells to 8 float32 vertices
+        # inside its writer (the full vertex array never materializes)
+        xyz_path = f"{prefix}.xyz.raw"
+        item = 8 * 3 * 4  # bytes per cell
+        offs = _exscan([(b - a) * item for a, b in extents])
+
+        def geom_bytes(a, b):
+            def make():
+                xyz = (
+                    origin[a:b, None, :]
+                    + _CORNERS[None, :, :] * h[a:b, None, None]
+                ).astype(np.float32)
+                return xyz.tobytes()
+            return make
+
+        jobs = [(off, geom_bytes(a, b))
+                for (a, b), off in zip(extents, offs)]
+        _pwrite_extents(xyz_path, jobs, ncell * item, pool)
+        bytes_written += ncell * item
+        files.append(xyz_path)
+
+        for name, arr in fields.items():
+            a = np.asarray(arr, np.float32).reshape(-1)
+            if a.size != ncell:
+                raise ValueError(
+                    f"field {name}: {a.size} values vs {ncell} cells"
+                )
+            attr_path = f"{prefix}.{name}.attr.raw"
+            offs = _exscan([(hi - lo) * 4 for lo, hi in extents])
+            jobs = [(off, a[lo:hi].tobytes())
+                    for (lo, hi), off in zip(extents, offs)]
+            _pwrite_extents(attr_path, jobs, ncell * 4, pool)
+            bytes_written += ncell * 4
+            files.append(attr_path)
+            with open(f"{prefix}.{name}.xdmf2", "w") as f:
+                f.write(
+                    _XDMF.format(
+                        time=time_,
+                        ncell=ncell,
+                        nvert=8 * ncell,
+                        name=name,
+                        xyz=os.path.basename(xyz_path),
+                        attr=os.path.basename(attr_path),
+                    )
+                )
+            files.append(f"{prefix}.{name}.xdmf2")
+    finally:
+        if pool is not None:
+            pool.shutdown()
+    return {"bytes_written": bytes_written, "shards": len(extents),
+            "files": files}
+
+
+class AsyncDumper:
+    """Off-critical-path snapshot writer.
+
+    ``submit()`` captures DEVICE field references (immutable), starts
+    their async host copies, and queues one background write job; the
+    step loop continues immediately.  The writer thread materializes the
+    fields (``np.asarray`` — nearly free once the async copy lands) and
+    runs the sharded multi-writer dump.  ``wait()`` joins all pending
+    writes and re-raises the first failure; drivers call it at run end
+    and before any operation that must observe the files on disk.
+
+    ``max_pending`` bounds host memory: submitting beyond it blocks on
+    the oldest write (a dump burst cannot queue unbounded field copies).
+    """
+
+    def __init__(self, nshards: int = 0, max_pending: int = 2):
+        self.nshards = nshards
+        self.max_pending = max_pending
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pending: List = []
+        self.stats = {"dumps": 0, "bytes_written": 0, "write_s": 0.0,
+                      "submit_s": 0.0}
+
+    def submit(self, prefix: str, time_: float, grid,
+               fields: Dict[str, "object"]) -> None:
+        t0 = time.perf_counter()
+        staged = {}
+        for name, arr in fields.items():
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass  # numpy arrays / platforms without async copies
+            staged[name] = arr
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                1, thread_name_prefix="cup3d-dump"
+            )
+        while len(self._pending) >= self.max_pending:
+            self._pending.pop(0).result()
+        self._pending.append(
+            self._pool.submit(self._write, prefix, time_, grid, staged)
+        )
+        self.stats["dumps"] += 1
+        self.stats["submit_s"] += time.perf_counter() - t0
+
+    def _write(self, prefix, time_, grid, staged):
+        t0 = time.perf_counter()
+        host = {k: np.asarray(v) for k, v in staged.items()}
+        out = dump_fields_sharded(prefix, time_, grid, host,
+                                  nshards=self.nshards)
+        self.stats["bytes_written"] += out["bytes_written"]
+        self.stats["write_s"] += time.perf_counter() - t0
+        return out
+
+    def wait(self) -> None:
+        pending, self._pending = self._pending, []
+        for fut in pending:
+            fut.result()
+
+    def __bool__(self):
+        return bool(self._pending)

@@ -42,6 +42,7 @@ def _run(pipelined, nsteps=5, factory=TWO_SPHERES, adapt=True,
 
 
 @pytest.mark.parametrize("adapt", [False, True])
+@pytest.mark.slow
 def test_pipelined_matches_host_path(adapt):
     """Fixed dt: the device rigid chain never depends on host mirrors, so
     pipelined and host-path trajectories agree to f32 round-off.  The
@@ -67,6 +68,7 @@ def test_pipelined_matches_host_path(adapt):
     np.testing.assert_allclose(pipe.uinf, ref.uinf, rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipelined_two_fish_matches_host_path():
     """The resolved two-fish acceptance topology (levelMax=4): megastep
     vs host path, crossing the early-step adaptations."""
@@ -83,6 +85,7 @@ def test_pipelined_two_fish_matches_host_path():
     assert np.linalg.norm(pipe.obstacles[0].transVel) > 0.0
 
 
+@pytest.mark.slow
 def test_pipelined_obstacle_free_matches_host():
     """Obstacle-free fused stepping (advance_pipelined_free) reproduces
     the host path on a mixed-level Taylor-Green run."""
@@ -121,6 +124,7 @@ def test_pipelined_rejects_roll_corrected_fish():
         )
 
 
+@pytest.mark.slow
 def test_pipelined_stale_pid_fish_runs():
     """Position/depth PID fish run in pipelined mode on stale mirrors
     (bounded by the grouped-read cadence) and track the host path."""
@@ -143,6 +147,7 @@ def test_pipelined_stale_pid_fish_runs():
     )
 
 
+@pytest.mark.slow
 def test_pipelined_collision_fallback():
     """Two spheres driven into contact: the stale overlap pre-check in the
     pack must latch _collision_hot, reroute stepping to the host path
@@ -180,6 +185,7 @@ def test_pipelined_collision_fallback():
     assert v_rel > -4.0
 
 
+@pytest.mark.slow
 def test_pipelined_umax_tracks_flow():
     """The stale-read dt machinery still produces a sane CFL dt chain
     (growth bounded, no runaway) when dt is adaptive."""
@@ -203,6 +209,7 @@ def test_pipelined_umax_tracks_flow():
         assert b <= 1.05 * a + 1e-12
 
 
+@pytest.mark.slow
 def test_device_dt_chain_matches_host_policy():
     """Device-resident dt chain (dtDevice=1, obstacle-free CFL runs)
     implements the NON-pipelined fresh-umax dt policy exactly (no 1.5x

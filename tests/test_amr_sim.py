@@ -2,6 +2,8 @@
 (reference Simulation::adaptMesh + init loop, main.cpp:15161-15200)."""
 
 import jax.numpy as jnp
+
+import pytest
 import numpy as np
 
 from cup3d_tpu.config import SimulationConfig
@@ -33,6 +35,7 @@ def test_amr_tgv_runs_and_projects(tmp_path):
     assert float(jnp.max(jnp.abs(div))) < 0.05
 
 
+@pytest.mark.slow
 def test_amr_grid_converges_onto_sphere(tmp_path):
     cfg = SimulationConfig(
         bpdx=2, bpdy=2, bpdz=2, levelMax=3, levelStart=0,
@@ -56,6 +59,7 @@ def test_amr_grid_converges_onto_sphere(tmp_path):
     assert bool(jnp.all(jnp.isfinite(s.state["vel"])))
 
 
+@pytest.mark.slow
 def test_amr_naca_runs(tmp_path):
     """The Naca obstacle is layout-generic (its SDF evaluates at arbitrary
     cell centers): the AMR driver refines onto the airfoil and steps."""

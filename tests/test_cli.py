@@ -8,6 +8,7 @@ import pytest
 from cup3d_tpu.__main__ import build_driver, main
 
 
+@pytest.mark.slow
 def test_runsh_command_line_launches(tmp_path):
     """The reference acceptance command line (run.sh, translated flags,
     reduced size) round-trips: two StefanFish on the adaptive forest."""

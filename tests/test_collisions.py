@@ -2,6 +2,8 @@
 ElasticCollision, main.cpp:13939-14325)."""
 
 import jax.numpy as jnp
+
+import pytest
 import numpy as np
 
 from cup3d_tpu.models.collisions import (
@@ -133,6 +135,7 @@ def test_no_overlap_no_collision():
     )
 
 
+@pytest.mark.slow
 def test_two_fish_collision_in_simulation():
     """End-to-end: two fish spawned overlapping nose-to-nose on the AMR
     driver; the run stays finite and the bodies do not interpenetrate

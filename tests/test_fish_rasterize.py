@@ -2,6 +2,8 @@
 StefanFish; main.cpp:11350-11739, 15668-15981)."""
 
 import jax.numpy as jnp
+
+import pytest
 import numpy as np
 
 from cup3d_tpu.config import SimulationConfig
@@ -78,6 +80,7 @@ def _fish_sim(n=48, tend=0.0, nsteps=3, correct=False):
     return s
 
 
+@pytest.mark.slow
 def test_stefanfish_swims():
     sim = _fish_sim(n=48, nsteps=6)
     fish = sim.sim.obstacles[0]

@@ -204,3 +204,52 @@ def test_twolevel_cuts_iterations(bc):
     # converged solution really solves the system
     res = A(x2) - (bt - jnp.mean(bt))
     assert float(rn2) <= max(1e-6, 1e-4 * float(ref)) * 1.01
+
+
+@pytest.mark.parametrize("bc", [BC.periodic, BC.wall])
+def test_coarse_solve_degenerate_axis_matches_galerkin(bc):
+    """An axis with a single tile must contribute a 1x1 coarse Laplacian
+    of 0 (isolated node) for both BC families, so the coarse solve equals
+    the pseudo-inverse of the exact Galerkin P^T A P and the constant
+    null mode is projected out (ADVICE r5: the wall branch used to pin
+    the lone diagonal to 1)."""
+    bs = 8
+    g = UniformGrid((8, 16, 16), (0.5, 1.0, 1.0), (bc,) * 3)
+    nb = (1, 2, 2)
+    solve_vec = krylov._make_coarse_solve_vec(g, bs=bs)
+
+    # explicit exact Galerkin coarse operator: A_c = -(bs^2/h^2)(Lx+Ly+Lz)
+    def lap1d(n):
+        if n == 1:
+            return np.zeros((1, 1))
+        L = 2.0 * np.eye(n) - np.diag(np.ones(n - 1), 1) \
+            - np.diag(np.ones(n - 1), -1)
+        if bc == BC.periodic:
+            L[0, -1] -= 1.0
+            L[-1, 0] -= 1.0
+        else:
+            L[0, 0] = 1.0
+            L[-1, -1] = 1.0
+        return L
+
+    eye = [np.eye(n) for n in nb]
+    Lsum = (
+        np.kron(np.kron(lap1d(nb[0]), eye[1]), eye[2])
+        + np.kron(np.kron(eye[0], lap1d(nb[1])), eye[2])
+        + np.kron(np.kron(eye[0], eye[1]), lap1d(nb[2]))
+    )
+    A_c = -(bs * bs / (g.h * g.h)) * Lsum
+
+    rng = np.random.default_rng(7)
+    rt = jnp.asarray(
+        rng.standard_normal((bs, bs, bs, int(np.prod(nb)))), jnp.float32
+    )
+    rc = np.asarray(jnp.sum(rt, axis=(0, 1, 2)))  # P^T r, lane order
+    want = np.linalg.pinv(A_c) @ rc
+    got = np.asarray(solve_vec(rt))
+    np.testing.assert_allclose(got, want, atol=2e-4 * max(1.0, np.abs(want).max()))
+    # the global-constant null mode is projected out exactly: a constant
+    # residual produces zero coarse correction
+    const = jnp.ones((bs, bs, bs, int(np.prod(nb))), jnp.float32)
+    zc = np.asarray(solve_vec(const))
+    assert np.abs(zc).max() < 1e-5

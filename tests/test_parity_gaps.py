@@ -113,6 +113,7 @@ def test_coil_vorticity_ic_uniform():
     assert corr > 0.5, corr
 
 
+@pytest.mark.slow
 def test_coil_vorticity_ic_amr_driver():
     from cup3d_tpu.config import SimulationConfig
     from cup3d_tpu.sim.amr import AMRSimulation
@@ -132,6 +133,7 @@ def test_coil_vorticity_ic_amr_driver():
     assert np.isfinite(np.asarray(sim.state["vel"])).all()
 
 
+@pytest.mark.slow
 def test_sharded_checkpoint_restore(tmp_path):
     """An AMR checkpoint saved from a single-device run restores INTO
     mesh mode and continues with the single-device trajectory."""

@@ -57,6 +57,7 @@ def test_sharded_step_matches_single_device():
     assert len(sh_vel.sharding.device_set) == 8
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_entrypoint():
     import importlib.util, pathlib
 

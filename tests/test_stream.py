@@ -1,0 +1,295 @@
+"""stream/ subsystem (async host data-plane, ISSUE 1): QoI streaming
+(FIFO ordering, bounded staleness under backpressure, pack slimming),
+sharded multi-writer dumps (byte-identical reassembly vs the
+single-writer path), and off-critical-path checkpoints
+(restore-compatible with io/checkpoint.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cup3d_tpu.stream.checkpoint import AsyncCheckpointer
+from cup3d_tpu.stream.dump import (
+    AsyncDumper,
+    _exscan,
+    _extents,
+    dump_fields_sharded,
+)
+from cup3d_tpu.stream.qoi import PackPolicy, QoIStream
+
+
+def _entry(i, size=3):
+    return {
+        "layout": [("val", size)],
+        "pack": jnp.full((size,), float(i), jnp.float32),
+        "idx": i,
+    }
+
+
+# -- QoI stream -------------------------------------------------------------
+
+
+def test_fifo_consume_ordering():
+    seen = []
+    st = QoIStream(lambda e: seen.append(e["idx"]), read_every=2,
+                   max_inflight=1)
+    for i in range(11):
+        st.emit(_entry(i))
+    st.flush()
+    assert seen == list(range(11))
+    assert not st  # fully drained
+    assert st.stats["packs_consumed"] == 11
+
+
+def test_values_roundtrip_through_groups():
+    got = {}
+
+    def consume(e):
+        vals = e.get("vals")
+        if vals is None:
+            vals = np.asarray(e["pack"], np.float64)
+        got[e["idx"]] = vals
+
+    st = QoIStream(consume, read_every=3, max_inflight=2)
+    for i in range(10):
+        st.emit(_entry(i))
+    st.flush()
+    for i in range(10):
+        np.testing.assert_allclose(got[i], float(i))
+    # counters saw the traffic: 3 full groups of 3 packs rode the stream
+    assert st.stats["groups_started"] >= 3
+    assert st.stats["bytes_streamed"] >= 3 * 3 * 3 * 4
+
+
+def test_bounded_staleness_under_backpressure(monkeypatch):
+    """With readiness polling disabled (every batch reports not-ready),
+    progress happens ONLY through emit()'s backpressure wait — in-flight
+    groups stay bounded and no entry gets staler than
+    (1 + max_inflight) * read_every emissions."""
+    read_every, max_inflight = 2, 2
+    consumed = []
+    st = QoIStream(lambda e: consumed.append(e["idx"]),
+                   read_every=read_every, max_inflight=max_inflight)
+    monkeypatch.setattr(QoIStream, "_ready",
+                        staticmethod(lambda batch: False))
+    bound = (1 + max_inflight) * read_every
+    for i in range(25):
+        st.emit(_entry(i))
+        assert len(st._inflight) <= max_inflight
+        assert len(st.queue) < read_every
+        newest_unconsumed = consumed[-1] + 1 if consumed else 0
+        assert i - newest_unconsumed < bound
+    # the forced not-ready reads were accounted as stalls
+    assert st.stats["groups_read"] > 0
+    assert st.stats["stall_s"] >= 0.0 and st.stats["read_s"] == 0.0
+    st.flush()
+    assert consumed == list(range(25))
+
+
+def test_kick_respects_inflight_limit():
+    st = QoIStream(lambda e: None, read_every=4, max_inflight=1)
+    st.emit(_entry(0))
+    st._inflight.append({"batch": jnp.zeros(1), "group": []})  # saturate
+    st.kick()
+    assert len(st._inflight) == 1  # kick at the limit is a no-op
+    assert len(st.queue) == 1
+
+
+def test_pack_slimming_roundtrip():
+    """A 256^3-style slim pack (scalars only) reproduces the full pack's
+    QoI values exactly; the dropped full-field part never ships."""
+    rng = np.random.default_rng(0)
+    big = jnp.asarray(rng.random(5000), jnp.float32)
+    rigid = jnp.arange(19, dtype=jnp.float32)
+    umax = jnp.asarray([7.0], jnp.float32)
+
+    def run(policy):
+        got = {}
+
+        def consume(e):
+            vals = e.get("vals")
+            if vals is None:
+                vals = np.asarray(e["pack"], np.float64)
+            off = 0
+            for name, size in e["layout"]:
+                got[name] = np.array(vals[off:off + size])
+                off += size
+
+        st = QoIStream(consume, read_every=1, policy=policy)
+        st.emit(st.pack_parts(
+            [("rigid", rigid), ("scores", big), ("umax", umax)],
+            jnp.float32,
+        ))
+        st.flush()
+        return got, st
+
+    full, st_full = run(PackPolicy())
+    slim, st_slim = run(PackPolicy(max_part_elems=4096))
+    assert "scores" in full and "scores" not in slim
+    np.testing.assert_allclose(slim["rigid"], full["rigid"])
+    np.testing.assert_allclose(slim["umax"], full["umax"])
+    assert st_slim.stats["parts_dropped"] == 1
+    assert st_slim.stats["bytes_dropped"] == 5000 * 4
+    assert st_slim.stats["bytes_streamed"] \
+        < st_full.stats["bytes_streamed"]
+
+
+def test_pack_policy_required_parts_always_ship():
+    pol = PackPolicy(max_part_elems=8, drop=("penal",))
+    assert pol.admits("umax", 10**6)  # required beats every filter
+    assert pol.admits("rigid", 10**6)
+    assert not pol.admits("penal", 2)
+    assert not pol.admits("scores", 9)
+    assert pol.admits("forces", 8)
+
+
+def test_pack_policy_for_cells():
+    assert PackPolicy.for_cells(256**3).max_part_elems > 0  # slimmed
+    assert PackPolicy.for_cells(128**3).max_part_elems == 0  # full packs
+
+
+# -- sharded dump -----------------------------------------------------------
+
+
+def test_extents_and_exscan():
+    ext = _extents(10, 4)
+    assert ext[0][0] == 0 and ext[-1][1] == 10
+    assert all(a < b for a, b in ext)
+    assert [e[0] for e in ext[1:]] == [e[1] for e in ext[:-1]]  # contiguous
+    assert _exscan([12, 8, 20]) == [0, 12, 20]
+    assert _extents(3, 8) == [(0, 1), (1, 2), (2, 3)]  # never empty shards
+
+
+@pytest.mark.parametrize("nshards", [1, 3, 8])
+def test_sharded_dump_byte_identical_uniform(tmp_path, nshards):
+    from cup3d_tpu.grid.uniform import BC, UniformGrid
+    from cup3d_tpu.io.dump import dump_fields, read_dump
+
+    g = UniformGrid((16, 8, 8), (2.0, 1.0, 1.0), (BC.periodic,) * 3)
+    rng = np.random.default_rng(1)
+    fields = {
+        "chi": rng.random((16, 8, 8)).astype(np.float32),
+        "velx": rng.standard_normal((16, 8, 8)).astype(np.float32),
+    }
+    dump_fields(str(tmp_path / "ref" / "snap"), 0.5, g, fields)
+    out = dump_fields_sharded(str(tmp_path / "sh" / "snap"), 0.5, g,
+                              fields, nshards=nshards)
+    assert out["shards"] == nshards
+    for suffix in (".xyz.raw", ".chi.attr.raw", ".velx.attr.raw",
+                   ".chi.xdmf2", ".velx.xdmf2"):
+        a = (tmp_path / "ref" / f"snap{suffix}").read_bytes()
+        b = (tmp_path / "sh" / f"snap{suffix}").read_bytes()
+        assert a == b, f"shard count {nshards}: {suffix} differs"
+    # and the post.py-style reader reassembles identically
+    c_ref, a_ref = read_dump(str(tmp_path / "ref" / "snap.chi.xdmf2"))
+    c_sh, a_sh = read_dump(str(tmp_path / "sh" / "snap.chi.xdmf2"))
+    np.testing.assert_array_equal(a_ref, a_sh)
+    np.testing.assert_array_equal(c_ref, c_sh)
+
+
+def test_sharded_dump_byte_identical_blocks(tmp_path):
+    """Mixed-level BlockGrid forest: the sharded writer's extents cut
+    straight through block boundaries and still reassemble bit-exact."""
+    from cup3d_tpu.grid.blocks import BlockGrid
+    from cup3d_tpu.grid.octree import Octree, TreeConfig
+    from cup3d_tpu.grid.uniform import BC
+    from cup3d_tpu.io.dump import dump_fields
+
+    tree = Octree(TreeConfig((2, 2, 2), 2, (True,) * 3), 0)
+    tree.refine((0, 0, 0, 0))
+    g = BlockGrid(tree, (1.0, 1.0, 1.0), (BC.periodic,) * 3)
+    f = np.arange(g.nb * 512, dtype=np.float32).reshape(g.nb, 8, 8, 8)
+    dump_fields(str(tmp_path / "ref" / "amr"), 0.0, g, {"chi": f})
+    dump_fields_sharded(str(tmp_path / "sh" / "amr"), 0.0, g, {"chi": f},
+                        nshards=5)
+    for suffix in (".xyz.raw", ".chi.attr.raw", ".chi.xdmf2"):
+        assert (tmp_path / "ref" / f"amr{suffix}").read_bytes() \
+            == (tmp_path / "sh" / f"amr{suffix}").read_bytes()
+
+
+def test_async_dumper_stages_device_fields(tmp_path):
+    from cup3d_tpu.grid.uniform import BC, UniformGrid
+    from cup3d_tpu.io.dump import read_dump
+
+    g = UniformGrid((8, 8, 8), (1.0, 1.0, 1.0), (BC.periodic,) * 3)
+    chi = jnp.asarray(
+        np.random.default_rng(2).random((8, 8, 8)), jnp.float32
+    )
+    d = AsyncDumper(nshards=3)
+    d.submit(str(tmp_path / "snap"), 0.25, g, {"chi": chi})
+    d.wait()
+    assert not d
+    _, attr = read_dump(str(tmp_path / "snap.chi.xdmf2"))
+    np.testing.assert_array_equal(attr, np.asarray(chi).reshape(-1))
+    assert d.stats["dumps"] == 1 and d.stats["bytes_written"] > 0
+
+
+# -- async checkpoints ------------------------------------------------------
+
+
+def test_async_checkpoint_restore_compatible(tmp_path):
+    """An AsyncCheckpointer save taken mid-run — with the run continuing
+    while the write is in flight — restores through the standard
+    io/checkpoint loader to the same state as a synchronous save."""
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+    from cup3d_tpu.sim.simulation import Simulation
+
+    cfg = SimulationConfig(
+        bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=1, extent=1.0,
+        CFL=0.3, nu=1e-3, tend=0.0, nsteps=8, initCond="taylorGreen",
+        poissonSolver="spectral", verbose=False, freqDiagnostics=0,
+        path4serialization=str(tmp_path),
+    )
+    sim = Simulation(cfg)
+    sim.init()
+    for _ in range(3):
+        sim.advance(sim.calc_max_timestep())
+    ck = AsyncCheckpointer()
+    path_async = ck.save(sim, str(tmp_path / "ck_async.pkl"))
+    path_sync = save_checkpoint(sim, str(tmp_path / "ck_sync.pkl"))
+    # the snapshot must be immune to the run continuing underneath it
+    for _ in range(2):
+        sim.advance(sim.calc_max_timestep())
+    ck.wait()
+
+    res_a = load_checkpoint(path_async)
+    res_s = load_checkpoint(path_sync)
+    assert res_a.sim.step == res_s.sim.step == 3
+    for k in res_s.sim.state:
+        np.testing.assert_array_equal(
+            np.asarray(res_a.sim.state[k]), np.asarray(res_s.sim.state[k])
+        )
+    # both restores continue identically (bit-exact jitted kernels)
+    res_a.advance(res_a.calc_max_timestep())
+    res_s.advance(res_s.calc_max_timestep())
+    np.testing.assert_array_equal(
+        np.asarray(res_a.sim.state["vel"]), np.asarray(res_s.sim.state["vel"])
+    )
+
+
+def test_driver_streams_drain_on_simulate(tmp_path):
+    """fdump/saveFreq output issued through the async data-plane lands on
+    disk by the time simulate() returns, and restores cleanly."""
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.io.checkpoint import load_checkpoint
+    from cup3d_tpu.sim.simulation import Simulation
+
+    cfg = SimulationConfig(
+        bpdx=2, bpdy=2, bpdz=2, levelMax=2, levelStart=1, extent=1.0,
+        CFL=0.3, nu=1e-3, tend=0.0, nsteps=4, initCond="taylorGreen",
+        poissonSolver="spectral", verbose=False, freqDiagnostics=0,
+        fdump=2, saveFreq=2, dumpChi=True,
+        path4serialization=str(tmp_path),
+    )
+    sim = Simulation(cfg)
+    sim.init()
+    sim.simulate()
+    import os
+
+    files = os.listdir(tmp_path)
+    assert any(f.endswith(".chi.xdmf2") for f in files)
+    assert "ckpt_0000002.pkl" in files
+    res = load_checkpoint(str(tmp_path / "ckpt_0000002.pkl"))
+    assert res.sim.step == 2

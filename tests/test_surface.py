@@ -84,6 +84,7 @@ def test_torque_about_center_vanishes_for_radial_pressure():
     assert np.linalg.norm(T) < 1e-4
 
 
+@pytest.mark.slow
 def test_block_window_matches_dense():
     """The AMR block-window extraction reproduces the same integrals as a
     direct dense window on a uniform single-level forest."""
@@ -243,6 +244,7 @@ def test_truncation_keeps_largest_measure():
     assert rel < 0.15
 
 
+@pytest.mark.slow
 def test_dump_surface_points_driver(tmp_path):
     """End-to-end: a sphere on the AMR driver dumps a compact per-point
     surface record whose traction sums match the obstacle's stored
