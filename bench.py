@@ -93,18 +93,28 @@ class _maybe_trace:
 
 
 def _time_steps(advance, calc_dt, warmup: int, iters: int,
-                tag: str = "run") -> float:
+                tag: str = "run", sync_state=None) -> float:
+    """Mean wall per step.  ``sync_state`` returns the driver's live
+    device state (fetched fresh each call: donated buffers rebind every
+    step); blocking on it before the window opens and before the closing
+    read makes the wall measure device execution, not dispatch (JX006)."""
+    import jax
+
     for _ in range(warmup):
         advance(calc_dt())
+    if sync_state is not None:
+        jax.block_until_ready(sync_state())
     with _maybe_trace(tag):
         t0 = time.perf_counter()
         for _ in range(iters):
             advance(calc_dt())
+        if sync_state is not None:
+            jax.block_until_ready(sync_state())
         return (time.perf_counter() - t0) / iters
 
 
 def _time_steps_robust(advance, calc_dt, warmup: int, iters: int,
-                       tag: str = "run"):
+                       tag: str = "run", sync_state=None):
     """Per-step walls -> (trimmed mean, mean, max).
 
     Pipelined drivers are structurally bimodal (most steps are async
@@ -115,13 +125,27 @@ def _time_steps_robust(advance, calc_dt, warmup: int, iters: int,
     transport noise), so the primary number trims the top 10% of samples:
     the regular read cadence stays in, the transport outliers fall out.
     The untrimmed mean and max quantify the stall exposure."""
+    import jax
+
     for _ in range(warmup):
         advance(calc_dt())
+    if sync_state is not None:
+        jax.block_until_ready(sync_state())
     walls = []
     with _maybe_trace(tag):
-        for _ in range(iters):
+        for i in range(iters):
             t0 = time.perf_counter()
             advance(calc_dt())
+            if sync_state is not None and i == iters - 1:
+                # drain the dispatch tail into the final sample so the
+                # window total is bounded by device completion; interior
+                # samples stay unsynced on purpose — each advance's dt
+                # host read bounds the PREVIOUS step, and syncing every
+                # step would serialize the pipelining being measured
+                jax.block_until_ready(sync_state())
+            # jax-lint: allow(JX006, per-step walls sample the pipelined
+            # cadence; the final iteration syncs via block_until_ready
+            # above and every advance's dt read bounds the prior step)
             walls.append(time.perf_counter() - t0)
     w = np.sort(np.asarray(walls))
     keep = max(1, int(np.ceil(len(w) * 0.9)))
@@ -171,7 +195,7 @@ def bench_fish_uniform(n_default: int = 128):
     sim._pack_reader.reset_stats()  # stream counters cover the timed window
     wall, wall_mean, wall_max = _time_steps_robust(
         sim.advance, sim.calc_max_timestep, warmup=0, iters=iters,
-        tag="fish",
+        tag="fish", sync_state=lambda: sim.sim.state["vel"],
     )
     stream = sim._pack_reader.snapshot()
     sim.flush_packs()
@@ -455,7 +479,8 @@ def bench_channel():
     sim.init()
     iters = 10
     wall = _time_steps(sim.advance, sim.calc_max_timestep, warmup=3,
-                       iters=iters, tag="channel")
+                       iters=iters, tag="channel",
+                       sync_state=lambda: sim.sim.state["vel"])
     from cup3d_tpu.ops import diagnostics as diag
 
     _, div_max = diag.divergence_norms(sim.sim.grid, sim.sim.state["vel"])
@@ -499,7 +524,7 @@ def bench_amr_tgv():
     # stay out of the timed window
     med, mean, wmax = _time_steps_robust(
         sim.advance, sim.calc_max_timestep, warmup=10, iters=iters,
-        tag="amr_tgv",
+        tag="amr_tgv", sync_state=lambda: sim.state["vel"],
     )
     stream = sim._pack_reader.snapshot()
     total, div_max = sim._divnorms(sim.state["vel"])
@@ -615,7 +640,7 @@ def bench_two_fish_amr():
     iters = 20
     med, mean, wmax = _time_steps_robust(
         sim.advance, sim.calc_max_timestep, warmup=25, iters=iters,
-        tag="two_fish_amr",
+        tag="two_fish_amr", sync_state=lambda: sim.state["vel"],
     )
     stream = sim._pack_reader.snapshot()
     sim.flush_packs()

@@ -1,0 +1,93 @@
+"""CLI: ``python -m cup3d_tpu.analysis [paths] [options]``.
+
+Exit status 0 iff every violation is either inline-annotated
+(``# jax-lint: allow(JX00n, reason)``) or covered by the baseline
+(``analysis/baseline.json`` by default).  Typical invocations::
+
+    python -m cup3d_tpu.analysis cup3d_tpu/            # the package
+    python -m cup3d_tpu.analysis cup3d_tpu/ bench.py   # + the bench
+    python -m cup3d_tpu.analysis --write-baseline ...  # start a burn-down
+    python -m cup3d_tpu.analysis --no-baseline ...     # the raw picture
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from cup3d_tpu.analysis import lint as lint_mod
+from cup3d_tpu.analysis.rules import RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cup3d_tpu.analysis",
+        description="JAX-aware AST lint (rules JX001-JX006)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report everything")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current violations to the baseline file "
+                         "(reasons left as TODO for the author)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule IDs to check (e.g. "
+                         "JX001,JX002)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only failing violations")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id}  {rule.title}")
+            print(f"       {rule.rationale}\n")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        import cup3d_tpu
+
+        paths = [cup3d_tpu.__path__[0]]
+
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = args.baseline or lint_mod.default_baseline_path()
+    rules = (set(r.strip().upper() for r in args.rules.split(","))
+             if args.rules else None)
+
+    violations = lint_mod.lint_paths(paths, baseline_path=baseline_path,
+                                     rules=rules)
+    if args.write_baseline:
+        out = args.baseline or lint_mod.default_baseline_path()
+        lint_mod.write_baseline(violations, out)
+        print(f"baseline written: {out} "
+              f"({len(lint_mod.failing(violations))} entries to justify)")
+        return 0
+
+    failing = lint_mod.failing(violations)
+    if args.format == "json":
+        print(json.dumps({
+            "violations": [v.__dict__ for v in violations],
+            "failing": len(failing),
+        }, indent=2))
+    else:
+        shown = failing if args.quiet else violations
+        for v in shown:
+            print(v.format())
+        n_sup = sum(1 for v in violations if v.suppressed)
+        n_base = sum(1 for v in violations if v.baselined)
+        print(
+            f"jax-lint: {len(violations)} finding(s): {len(failing)} "
+            f"failing, {n_sup} annotated, {n_base} baselined"
+        )
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,749 @@
+"""JAX-aware AST lint: machine-checked hot-path invariants.
+
+Static half of the analysis subsystem (see ``analysis/runtime`` for the
+sound runtime checks).  The linter is deliberately PRECISION-first: a
+Python AST cannot prove an expression holds a device array, so every
+rule fires only on patterns that are device-typed by construction or by
+this repo's conventions (``jnp.*`` calls, ``self._name(...)`` jitted
+wrappers, values assigned from them).  What the heuristics miss, the
+runtime transfer guard catches; what they flag wrongly, an inline
+annotation documents:
+
+    x = float(self._maxu(v))  # jax-lint: allow(JX001, designed dt sync)
+
+or a checked-in baseline entry (``analysis/baseline.json``) matched by
+(rule, path, enclosing function) so entries survive line drift.  The
+CLI (``python -m cup3d_tpu.analysis``) exits nonzero on any violation
+that is neither annotated nor baselined.
+
+Rule summary (full rationale in ``analysis/rules.py``):
+
+- JX001  host-sync call (``float``/``int``/``bool``/``.item()``/
+         ``np.asarray``/``jax.device_get``) on a device value inside a
+         hot-path function (step/solve/advance loops in ``sim/``,
+         ``ops/``, ``stream/``).
+- JX002  step-shaped ``jax.jit`` without ``donate_argnums``.
+- JX003  Python ``if``/``while``/ternary on a traced argument inside a
+         jitted body (covers the implicit ``__bool__`` host sync).
+- JX004  device-array construction inside a per-step Python loop in a
+         hot-path function.
+- JX005  float64 dtype literal in device code.
+- JX006  ``time.perf_counter()`` timing window with no device sync.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from cup3d_tpu.analysis.rules import RULES, Violation
+
+# -- scoping ----------------------------------------------------------------
+
+#: modules whose functions can be on the per-step critical path
+HOT_MODULE_RE = re.compile(r"cup3d_tpu/(sim|ops|stream)/")
+
+#: function names that run inside (or are) the step loop
+HOT_FUNC_RE = re.compile(
+    r"^(advance\w*|simulate|solve\w*|calc_max_timestep|_calc_dt\w*|"
+    r"_emit\w*|_consume\w*|emit|kick|poll|join|flush\w*|stage|"
+    r"_fix_mass_flux|_compute_forces|__call__|\w*step\w*|\w*megastep\w*)$"
+)
+
+#: names that mark a jitted function / its target as a steady-state step
+STEP_SHAPE_RE = re.compile(r"step|mega", re.IGNORECASE)
+
+#: host->device constructors relevant to JX004
+JNP_CONSTRUCTORS = frozenset(
+    {"asarray", "array", "zeros", "ones", "full", "arange", "linspace",
+     "eye"}
+)
+
+#: calls that force (or are) a device sync, for JX001/JX006
+SYNC_BUILTINS = frozenset({"float", "int", "bool"})
+
+#: array attributes that live on the HOST side of a jax Array (reading
+#: them never syncs), so int(x.size) etc. is not a JX001 hit
+HOST_METADATA_ATTRS = frozenset(
+    {"size", "ndim", "shape", "dtype", "itemsize", "nbytes", "sharding"}
+)
+
+
+def _is_host_metadata(expr: ast.AST) -> bool:
+    """True when ``expr`` only reads host-side array metadata."""
+    node = expr
+    while isinstance(node, ast.Attribute):
+        if node.attr in HOST_METADATA_ATTRS:
+            return True
+        node = node.value
+    return False
+
+# reason may contain one level of nested parens: allow(JX001, freq (gated))
+ALLOW_RE = re.compile(
+    r"jax-lint:\s*allow\(\s*(JX\d{3})\s*"
+    r"(?:,\s*((?:[^()]|\([^()]*\))*?)\s*)?\)"
+)
+
+
+# -- suppressions -----------------------------------------------------------
+
+
+def parse_suppressions(source: str) -> Dict[int, Dict[str, str]]:
+    """line -> {rule: reason}.  An annotation on a pure-comment line (or a
+    block of them: a wrapped annotation continues across consecutive
+    comment lines) applies to the next CODE line; on a code line, to that
+    line."""
+    out: Dict[int, Dict[str, str]] = {}
+    lines = source.splitlines()
+    i = 0
+    while i < len(lines):
+        text = lines[i]
+        if text.lstrip().startswith("#"):
+            # join the whole comment block so wrapped annotations parse
+            start = i
+            while i < len(lines) and lines[i].lstrip().startswith("#"):
+                i += 1
+            joined = " ".join(
+                lines[j].lstrip().lstrip("#").strip()
+                for j in range(start, i)
+            )
+            matches = ALLOW_RE.findall(joined)
+            if matches:
+                target = i + 1  # 1-based number of the next code line
+                slot = out.setdefault(target, {})
+                for rule, reason in matches:
+                    slot[rule] = (reason or "").strip()
+            continue
+        # trailing annotation on a code line applies to that line
+        if "#" in text:
+            matches = ALLOW_RE.findall(text)
+            if matches:
+                slot = out.setdefault(i + 1, {})
+                for rule, reason in matches:
+                    slot[rule] = (reason or "").strip()
+        i += 1
+    return out
+
+
+# -- AST helpers ------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a Name/Attribute chain ('' otherwise)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _call_name(call: ast.Call) -> str:
+    return _dotted(call.func)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_jnp_call(call: ast.Call) -> bool:
+    name = _call_name(call)
+    root = name.split(".", 1)[0].lstrip("_")
+    return "." in name and root in ("jnp", "jax")
+
+
+def _is_jitwrapper_call(call: ast.Call) -> bool:
+    """``self._name(...)`` / ``s._name(...)``: the repo convention for
+    jitted step pieces held as driver attributes."""
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr.startswith("_")
+        and isinstance(f.value, ast.Name)
+    )
+
+
+def _is_device_call(call: ast.Call) -> bool:
+    return _is_jnp_call(call) or _is_jitwrapper_call(call)
+
+
+def _jit_target(call: ast.Call) -> Optional[ast.AST]:
+    """For a ``jax.jit(f, ...)`` call, the wrapped function node."""
+    if _call_name(call) in ("jax.jit", "jit") and call.args:
+        return call.args[0]
+    return None
+
+
+def _is_partial_of_jit(call: ast.Call) -> bool:
+    """``partial(jax.jit, ...)`` (any name ending in 'partial')."""
+    return (
+        _call_name(call).endswith("partial")
+        and bool(call.args)
+        and _dotted(call.args[0]) in ("jax.jit", "jit")
+    )
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            try:
+                v = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return set()
+            if isinstance(v, str):
+                return {v}
+            return set(v)
+    return set()
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _walk_shallow(func: ast.AST):
+    """Walk a function body WITHOUT descending into nested def/class —
+    every def gets its own visit from ``FileLint._functions``, so a deep
+    walk would double-count nested findings.  Lambdas stay in scope
+    (inline ``jax.jit(lambda ...)`` belongs to the enclosing def)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` (and `and`/`or`/`not` chains of
+    them): identity-vs-None is a structural check, static under trace."""
+    if isinstance(test, ast.BoolOp):
+        return all(_is_none_check(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_none_check(test.operand)
+    return (
+        isinstance(test, ast.Compare)
+        and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+        and all(
+            isinstance(c, ast.Constant) and c.value is None
+            for c in test.comparators
+        )
+    )
+
+
+def _inner_name(node: ast.AST) -> str:
+    """Name of the function being jitted: Name / Attribute / partial(f,…)
+    peeled recursively; lambdas are ''. """
+    if isinstance(node, ast.Call) and _call_name(node).endswith("partial"):
+        return _inner_name(node.args[0]) if node.args else ""
+    name = _dotted(node)
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+# -- per-function device-taint tracking (JX001) -----------------------------
+
+
+class _Taint:
+    """Names assigned (in source order) from device-producing calls."""
+
+    def __init__(self) -> None:
+        self.names: Set[str] = set()
+
+    def feed(self, stmt: ast.stmt) -> None:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+            value = stmt.value
+        if value is None:
+            return
+        tainted = any(
+            isinstance(n, ast.Call) and _is_device_call(n)
+            for n in ast.walk(value)
+        ) or bool(self.names & _names_in(value))
+        # a host read LAUNDERS the value: np.asarray(x) yields host data
+        for n in ast.walk(value):
+            if isinstance(n, ast.Call) and _call_name(n) in (
+                "np.asarray", "numpy.asarray", "jax.device_get"
+            ):
+                tainted = False
+        if not tainted:
+            return
+        # only PLAIN names (incl. tuple/list unpacks) become tainted:
+        # `self._x = jit(...)` must not taint `self` itself
+        for t in targets:
+            stack = [t]
+            while stack:
+                leaf = stack.pop()
+                if isinstance(leaf, ast.Name):
+                    self.names.add(leaf.id)
+                elif isinstance(leaf, (ast.Tuple, ast.List)):
+                    stack.extend(leaf.elts)
+                elif isinstance(leaf, ast.Starred):
+                    stack.append(leaf.value)
+
+    def covers(self, expr: ast.AST) -> bool:
+        if any(
+            isinstance(n, ast.Call) and _is_device_call(n)
+            for n in ast.walk(expr)
+        ):
+            return True
+        return bool(self.names & _names_in(expr))
+
+
+# -- the linter -------------------------------------------------------------
+
+
+@dataclass
+class FileLint:
+    path: str            # repo-relative posix path
+    tree: ast.Module
+    suppressions: Dict[int, Dict[str, str]]
+    violations: List[Violation] = field(default_factory=list)
+
+    def run(self) -> List[Violation]:
+        hot_module = bool(HOT_MODULE_RE.search(self.path))
+        jitted = self._collect_jitted_defs()
+        for func, qualname in self._functions():
+            hot = hot_module and bool(HOT_FUNC_RE.match(func.name))
+            if hot:
+                self._check_host_sync(func, qualname)       # JX001
+                self._check_loop_construction(func, qualname)  # JX004
+            self._check_jit_sites(func, qualname)           # JX002
+            if id(func) in jitted:
+                self._check_traced_control_flow(            # JX003
+                    func, qualname, jitted[id(func)]
+                )
+            self._check_timing_windows(func, qualname)      # JX006
+        self._check_dtype_literals()                        # JX005
+        return self.violations
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _functions(self):
+        """(FunctionDef, qualname) for every def, with class/def nesting."""
+        out = []
+
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{prefix}{child.name}"
+                    out.append((child, q))
+                    visit(child, f"{q}.")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.")
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+        return out
+
+    def _emit(self, rule: str, node: ast.AST, func: str, msg: str) -> None:
+        v = Violation(
+            rule=rule, path=self.path, line=node.lineno,
+            col=node.col_offset, func=func, message=msg,
+        )
+        reason = self.suppressions.get(node.lineno, {}).get(rule)
+        if reason is not None:
+            v.suppressed = True
+            v.suppression_reason = reason or None
+        self.violations.append(v)
+
+    def _collect_jitted_defs(self) -> Dict[int, Set[str]]:
+        """id(FunctionDef) -> static argnames, for defs that are jitted:
+        decorated with jax.jit / partial(jax.jit, ...), or passed by name
+        to a jax.jit(...) call anywhere in the module."""
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        jitted: Dict[int, Set[str]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _dotted(dec) in ("jax.jit", "jit"):
+                        jitted[id(node)] = set()
+                    elif isinstance(dec, ast.Call) and (
+                        _dotted(dec.func) in ("jax.jit", "jit")
+                        or _is_partial_of_jit(dec)
+                    ):
+                        jitted[id(node)] = _static_argnames(dec)
+            elif isinstance(node, ast.Call):
+                target = _jit_target(node)
+                if target is not None:
+                    name = _dotted(target)
+                    if name in defs:
+                        jitted[id(defs[name])] = _static_argnames(node)
+        return jitted
+
+    # -- JX001 -------------------------------------------------------------
+
+    def _check_host_sync(self, func: ast.AST, qualname: str) -> None:
+        taint = _Taint()
+        for stmt in _walk_shallow(func):
+            if isinstance(stmt, ast.stmt):
+                taint.feed(stmt)
+        # `with sanctioned_transfer("tag"):` IS the designed-sync-point
+        # annotation — the runtime guard and the lint agree on the same
+        # marker, so a site is never annotated twice
+        sanctioned: List[Tuple[int, int, str]] = []
+        for node in _walk_shallow(func):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    c = item.context_expr
+                    if isinstance(c, ast.Call) and _call_name(c).endswith(
+                        "sanctioned_transfer"
+                    ):
+                        tag = ""
+                        if c.args and isinstance(c.args[0], ast.Constant):
+                            tag = str(c.args[0].value)
+                        sanctioned.append(
+                            (node.lineno, node.end_lineno or node.lineno,
+                             tag)
+                        )
+
+        def sanction_tag(line: int) -> Optional[str]:
+            for lo, hi, tag in sanctioned:
+                if lo <= line <= hi:
+                    return tag or "sanctioned"
+            return None
+
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            tag = sanction_tag(node.lineno)
+            if tag is not None:
+                n_before = len(self.violations)
+                self._try_host_sync_call(node, qualname, taint)
+                for v in self.violations[n_before:]:
+                    v.suppressed = True
+                    v.suppression_reason = (
+                        f"sanctioned_transfer({tag!r})"
+                    )
+                continue
+            self._try_host_sync_call(node, qualname, taint)
+
+    def _try_host_sync_call(
+        self, node: ast.Call, qualname: str, taint: "_Taint"
+    ) -> None:
+        name = _call_name(node)
+        if name == "jax.device_get":
+            self._emit("JX001", node, qualname,
+                       "jax.device_get blocks on a device->host read")
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            self._emit("JX001", node, qualname,
+                       ".item() blocks on a device->host read")
+        elif name in SYNC_BUILTINS and len(node.args) == 1:
+            if _is_host_metadata(node.args[0]):
+                return
+            if taint.covers(node.args[0]):
+                self._emit(
+                    "JX001", node, qualname,
+                    f"{name}() on a device value blocks the dispatch "
+                    "stream for a host round trip",
+                )
+        elif name in ("np.asarray", "numpy.asarray") and node.args:
+            if taint.covers(node.args[0]):
+                self._emit(
+                    "JX001", node, qualname,
+                    "np.asarray() of a device value is a blocking "
+                    "device->host transfer",
+                )
+
+    # -- JX002 -------------------------------------------------------------
+
+    def _check_jit_sites(self, func: ast.AST, qualname: str) -> None:
+        # assignment-target text per jit call, so `self._step = jax.jit(f)`
+        # is step-shaped even when f's own name is opaque
+        targets: Dict[int, str] = {}
+        for stmt in _walk_shallow(func):
+            if isinstance(stmt, ast.Assign):
+                t = " ".join(_dotted(x) for x in stmt.targets)
+                for sub in ast.walk(stmt.value):
+                    if isinstance(sub, ast.Call):
+                        targets[id(sub)] = t
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            wrapped = _jit_target(node)
+            if wrapped is None:
+                continue
+            step_shaped = (
+                STEP_SHAPE_RE.search(_inner_name(wrapped))
+                or STEP_SHAPE_RE.search(targets.get(id(node), ""))
+                or STEP_SHAPE_RE.search(qualname)
+            )
+            if step_shaped and not _has_kw(node, "donate_argnums"):
+                self._emit(
+                    "JX002", node, qualname,
+                    "step-shaped jax.jit without donate_argnums: the "
+                    "state buffers are copied instead of updated in "
+                    "place",
+                )
+
+    # -- JX003 -------------------------------------------------------------
+
+    def _check_traced_control_flow(
+        self, func: ast.AST, qualname: str, static: Set[str]
+    ) -> None:
+        args = func.args
+        params = {
+            a.arg
+            for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+            )
+        } - static - {"self"}
+        for node in ast.walk(func):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                if _is_none_check(node.test):
+                    continue  # `x is (not) None`: static under trace
+                traced = params & _names_in(node.test)
+                if traced:
+                    kind = type(node).__name__.lower()
+                    self._emit(
+                        "JX003", node, qualname,
+                        f"Python {kind} on traced argument(s) "
+                        f"{sorted(traced)} inside a jitted body (implicit "
+                        "__bool__ host sync or ConcretizationTypeError); "
+                        "use lax.cond/lax.while_loop/jnp.where or mark "
+                        "the argument static",
+                    )
+
+    # -- JX004 -------------------------------------------------------------
+
+    def _check_loop_construction(self, func: ast.AST, qualname: str) -> None:
+        for loop in _walk_shallow(func):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node)
+                if (
+                    name.split(".", 1)[0].lstrip("_") in ("jnp", "jax")
+                    and "." in name
+                    and name.rsplit(".", 1)[-1] in JNP_CONSTRUCTORS
+                ):
+                    self._emit(
+                        "JX004", node, qualname,
+                        f"{name}() inside a per-step Python loop "
+                        "dispatches one host->device upload per "
+                        "iteration; hoist or batch it",
+                    )
+
+    # -- JX005 -------------------------------------------------------------
+
+    def _check_dtype_literals(self) -> None:
+        if not re.search(r"cup3d_tpu/(sim|ops|grid|stream|models)/",
+                         self.path):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                if _dotted(node) in ("jnp.float64", "jax.numpy.float64"):
+                    self._emit(
+                        "JX005", node, "<module>",
+                        "jnp.float64 literal in device code; take the "
+                        "dtype from the config (sim.dtype)",
+                    )
+            elif isinstance(node, ast.Call) and _is_jnp_call(node):
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and (
+                        (isinstance(kw.value, ast.Constant)
+                         and kw.value.value == "float64")
+                        or _dotted(kw.value) in (
+                            "np.float64", "numpy.float64", "jnp.float64"
+                        )
+                    ):
+                        self._emit(
+                            "JX005", node, "<module>",
+                            "float64 dtype literal in a jnp constructor",
+                        )
+
+    # -- JX006 -------------------------------------------------------------
+
+    def _check_timing_windows(self, func: ast.AST, qualname: str) -> None:
+        """Between consecutive perf_counter() reads (and from function
+        start to the first one) there must be a sync: block_until_ready,
+        a host read (float/int/np.asarray/.item), or nothing dispatched
+        at all (no calls in the window)."""
+        pc_lines: List[int] = []
+        sync_lines: List[int] = []
+        call_lines: List[int] = []
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            line = node.lineno
+            if name.endswith("perf_counter"):
+                pc_lines.append(line)
+            elif (
+                name in SYNC_BUILTINS
+                or name in ("np.asarray", "numpy.asarray")
+                or name.endswith("block_until_ready")
+                or (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item")
+            ):
+                sync_lines.append(line)
+            else:
+                call_lines.append(line)
+        if len(pc_lines) < 2:
+            return
+        pc_lines.sort()
+        start = func.lineno
+        for pc in pc_lines:
+            window = (start, pc)
+            dispatches = any(window[0] <= l <= window[1]
+                             for l in call_lines)
+            synced = any(window[0] <= l <= window[1] for l in sync_lines)
+            if dispatches and not synced:
+                v = Violation(
+                    rule="JX006", path=self.path, line=pc, col=0,
+                    func=qualname,
+                    message=(
+                        "perf_counter() read with dispatched device work "
+                        "and no block_until_ready/host-read sync since "
+                        f"line {window[0]}: the window times dispatch, "
+                        "not device execution"
+                    ),
+                )
+                reason = self.suppressions.get(pc, {}).get("JX006")
+                if reason is not None:
+                    v.suppressed = True
+                    v.suppression_reason = reason or None
+                self.violations.append(v)
+            start = pc
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: Optional[str]) -> Dict[Tuple[str, str, str], dict]:
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out = {}
+    for e in data.get("entries", []):
+        out[(e["rule"], e["path"], e["func"])] = {
+            "reason": e.get("reason", ""),
+            "count": int(e.get("count", 1)),
+            "used": 0,
+        }
+    return out
+
+
+def apply_baseline(
+    violations: List[Violation],
+    baseline: Dict[Tuple[str, str, str], dict],
+) -> None:
+    """Mark violations covered by the baseline (up to each entry's count —
+    NEW violations in an already-baselined function still fail)."""
+    for v in violations:
+        if v.suppressed:
+            continue
+        entry = baseline.get(v.key())
+        if entry is not None and entry["used"] < entry["count"]:
+            entry["used"] += 1
+            v.baselined = True
+
+
+def write_baseline(violations: List[Violation], path: str) -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for v in violations:
+        if v.suppressed:
+            continue
+        counts[v.key()] = counts.get(v.key(), 0) + 1
+    entries = [
+        {"rule": r, "path": p, "func": f, "count": c,
+         "reason": "TODO: justify or fix"}
+        for (r, p, f), c in sorted(counts.items())
+    ]
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+# -- entry points -----------------------------------------------------------
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def repo_relative(path: str) -> str:
+    """Normalize to a posix path rooted at the repo (the directory that
+    contains the ``cup3d_tpu`` package), so baseline entries are stable
+    regardless of the CWD the CLI runs from."""
+    ap = os.path.abspath(path).replace(os.sep, "/")
+    marker = "/cup3d_tpu/"
+    idx = ap.rfind(marker)
+    if idx >= 0:
+        return ap[idx + 1:]
+    return os.path.basename(ap)
+
+
+def lint_source(
+    source: str, path: str = "<string>"
+) -> List[Violation]:
+    """Lint one source string (fixture tests use this directly)."""
+    tree = ast.parse(source)
+    return FileLint(path, tree, parse_suppressions(source)).run()
+
+
+def lint_paths(
+    paths: Sequence[str],
+    baseline_path: Optional[str] = None,
+    rules: Optional[Set[str]] = None,
+) -> List[Violation]:
+    violations: List[Violation] = []
+    for fpath in _iter_py_files(paths):
+        with open(fpath, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            violations.append(Violation(
+                rule="JX000", path=repo_relative(fpath),
+                line=e.lineno or 0, col=e.offset or 0, func="<module>",
+                message=f"syntax error: {e.msg}",
+            ))
+            continue
+        violations.extend(
+            FileLint(repo_relative(fpath), tree,
+                     parse_suppressions(source)).run()
+        )
+    if rules:
+        violations = [v for v in violations if v.rule in rules]
+    baseline = load_baseline(baseline_path)
+    apply_baseline(violations, baseline)
+    return violations
+
+
+def failing(violations: Iterable[Violation]) -> List[Violation]:
+    return [v for v in violations if not v.suppressed and not v.baselined]

@@ -1,0 +1,122 @@
+"""Rule catalog for the JAX-aware lint (``cup3d_tpu.analysis.lint``).
+
+Every hazard class that has actually cost this codebase wall-clock gets a
+stable rule ID, so violations can be suppressed individually (inline
+``# jax-lint: allow(JX00n, reason)``) or burned down against a checked-in
+baseline (``analysis/baseline.json``) without ever turning the whole
+checker off.
+
+The catalog is the machine-checked half of the sanitizer contract in
+VALIDATION.md ("Analysis subsystem: sanitizer contract"); the runtime
+half (recompile counter, transfer guard) lives in ``analysis/runtime``.
+
+Rule IDs are append-only: never renumber, never reuse.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    title: str
+    rationale: str
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            "JX001",
+            "host sync in hot-path function",
+            "float()/.item()/np.asarray()/jax.device_get() on device values "
+            "inside step/solve-loop functions blocks the dispatch stream for "
+            "a full device->host round trip (~75-200 ms over the tunneled "
+            "TPU).  PR 1 measured SyncQoI at 86% of the 256^3 fish step "
+            "before these were hoisted onto the stream/ data-plane.  Every "
+            "remaining sync must be a designed, annotated sync point.",
+        ),
+        Rule(
+            "JX002",
+            "step-shaped jax.jit without donate_argnums",
+            "A steady-state step function that maps state -> state and is "
+            "jitted without donating the state buffers doubles the field "
+            "working set in HBM and forces XLA to copy instead of aliasing "
+            "in-place.  At 256^3 the vel+p fields are ~400 MB; donation "
+            "makes the update O(1) extra memory.",
+        ),
+        Rule(
+            "JX003",
+            "Python control flow on traced values in a jitted body",
+            "`if`/`while` on a traced value inside a jitted function either "
+            "raises a ConcretizationTypeError or — when the value is an "
+            "argument that jit treats as dynamic — silently forces a "
+            "trace-time host sync and a recompile per branch outcome.  Use "
+            "lax.cond/lax.while_loop or jnp.where, or mark the argument "
+            "static.",
+        ),
+        Rule(
+            "JX004",
+            "device array construction inside a per-step Python loop",
+            "jnp.asarray/jnp.zeros/... inside a Python loop that runs every "
+            "step dispatches one host->device upload per iteration per "
+            "step.  Hoist the construction out of the loop, batch the "
+            "uploads, or keep the data device-resident across steps.",
+        ),
+        Rule(
+            "JX006",
+            "perf_counter timing window without a device sync",
+            "Timing a region that dispatches device work without a "
+            "block_until_ready()/host-read sync before the perf_counter "
+            "reads measures DISPATCH latency, not device execution: on an "
+            "async backend the reported time can be off by orders of "
+            "magnitude in either direction.  Every timed window must sync "
+            "before its start and before its closing read.",
+        ),
+        Rule(
+            "JX005",
+            "float64 dtype literal in device code",
+            "A bare float64 dtype in device code either doubles bandwidth "
+            "and VMEM pressure on TPU or silently promotes downstream "
+            "arithmetic.  Device-side dtypes must come from the config "
+            "(sim.dtype); float64 is reserved for host-side mirrors and "
+            "accumulations.",
+        ),
+    )
+}
+
+
+@dataclass
+class Violation:
+    """One lint finding.  ``func`` is the enclosing function's qualname —
+    the baseline matches on (rule, path, func) so entries survive line
+    drift from unrelated edits."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    func: str
+    message: str
+    suppressed: bool = False
+    suppression_reason: Optional[str] = None
+    baselined: bool = False
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.func)
+
+    def format(self) -> str:
+        tag = ""
+        if self.suppressed:
+            tag = f"  [allowed: {self.suppression_reason or 'no reason'}]"
+        elif self.baselined:
+            tag = "  [baselined]"
+        rule = RULES.get(self.rule)
+        title = rule.title if rule else "unknown rule"
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"({title}) in `{self.func}`: {self.message}{tag}"
+        )

@@ -615,20 +615,33 @@ def build_iterative_solver(
     h2 = grid.h * grid.h
     h3 = grid.h ** 3
 
-    # lanes layout: dense cell (0,0,0) lives at [0,0,0, lane 0]
+    # lanes layout: dense cell (0,0,0) lives at [0,0,0, lane 0].
+    # The replaced row is rescaled to the Laplacian's diagonal magnitude
+    # (6/h^2): its RHS entry is zeroed below, so row scaling leaves the
+    # solution unchanged, but an O(1) (pin) or O(h^3) (mean) row next to
+    # O(1/h^2) rows wrecks the conditioning and stalls float32 BiCGSTAB
+    # (ADVICE r5 regression test: test_mean_constraint_pinned_paths)
+    pin = 6.0 / h2
     if mean_constraint == 1:
-        A = lambda t: A0(t).at[0, 0, 0, 0].set(jnp.sum(t) * h3)
+        A = lambda t: A0(t).at[0, 0, 0, 0].set(jnp.sum(t) * h3 * pin)
     elif mean_constraint == 3:
-        A = lambda t: A0(t).at[0, 0, 0, 0].set(t[0, 0, 0, 0])
+        A = lambda t: A0(t).at[0, 0, 0, 0].set(t[0, 0, 0, 0] * pin)
     else:
         A = A0
 
-    if use_coarse_correction():
+    if use_coarse_correction() and mean_constraint not in (1, 3):
         # multiplicative two-level: 12 outer iterations vs 51 tile-only at
         # 128^3, resolution-independent (make_twolevel_preconditioner_lanes)
         M = make_twolevel_preconditioner_lanes(grid, h2, precond_bs,
                                                precond_iters)
     else:
+        # mean_constraint 1/3 pin one equation row, making A nonsingular —
+        # but the two-level M's exact Galerkin coarse solve is built from
+        # the UNMODIFIED singular Laplacian, so its pseudo-inverse projects
+        # the constant mode back out and the preconditioned operator
+        # reintroduces the nullspace the pin removed (ADVICE r5).  The
+        # tile-local getZ has no global coupling, so it is unaffected by
+        # the single-row modification.
 
         def M(r):
             return getz_lanes(-h2 * r, cg_iters=precond_iters)
@@ -667,10 +680,12 @@ def _build_iterative_solver_dense(
     A0 = make_laplacian(grid)
     M = make_block_cg_preconditioner(precond_bs, precond_iters, h=grid.h)
     h3 = grid.h ** 3
+    # pin-row rescale: same conditioning fix as the lanes path above
+    pin = 6.0 / (grid.h * grid.h)
     if mean_constraint == 1:
-        A = lambda x: A0(x).at[0, 0, 0].set(jnp.sum(x) * h3)
+        A = lambda x: A0(x).at[0, 0, 0].set(jnp.sum(x) * h3 * pin)
     elif mean_constraint == 3:
-        A = lambda x: A0(x).at[0, 0, 0].set(x[0, 0, 0])
+        A = lambda x: A0(x).at[0, 0, 0].set(x[0, 0, 0] * pin)
     else:
         A = A0
 

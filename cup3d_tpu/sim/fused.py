@@ -24,13 +24,18 @@ from cup3d_tpu.ops.projection import project
 
 
 def make_step(grid: UniformGrid, nu: float, solver, with_bodies: bool = False,
-              jit: bool = True):
+              jit: bool = True, donate: bool = True):
     """Returns step(vel, dt, uinf[, chi, ubody, udef, lam]) -> (vel, p).
 
     All runtime scalars are traced arguments, so dt/lambda changes never
     recompile.  `with_bodies` switches in the penalization + pressure-RHS
     obstacle terms (static switch = two compiled variants at most).
     Pass jit=False to wrap the raw function yourself (e.g. with shardings).
+
+    By default the velocity buffer is DONATED (JX002): vel -> vel aliases
+    in place, so callers must rebind (`vel, p = step(vel, ...)`) and never
+    touch the passed-in array again.  Pass donate=False to keep the input
+    readable (comparison tests that reuse one initial condition).
     """
 
     if with_bodies:
@@ -48,4 +53,6 @@ def make_step(grid: UniformGrid, nu: float, solver, with_bodies: bool = False,
             vel, p = project(grid, vel, dt, solver)
             return vel, p
 
-    return jax.jit(step) if jit else step
+    if not jit:
+        return step
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -14,6 +14,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from cup3d_tpu.analysis.runtime import device_scalar, sanctioned_transfer
 from cup3d_tpu.config import SimulationConfig, parse_factory
 from cup3d_tpu.ops import diagnostics as diag
 from cup3d_tpu.sim import operators as ops
@@ -134,18 +135,23 @@ class Simulation:
                     max(ob.max_body_speed(s.uinf) for ob in s.obstacles),
                 )
         else:
-            umax = float(self._max_u(s.state["vel"], s.uinf_device()))
-            if s.obstacles:
-                # the CFL scale must see the BODY kinematics immediately:
-                # at full gait amplitude the tail's deformation velocity
-                # reaches the advective limit one step before it imprints
-                # on the measured fluid field (blow-up observed at the
-                # diffusive-cap dt otherwise)
-                import jax.numpy as _jnp
-
-                umax = max(
-                    umax, float(_jnp.max(_jnp.abs(s.state["udef"])))
+            # the designed once-per-step dt sync of the non-pipelined
+            # path (the ONLY device->host read its steady-state step pays)
+            with sanctioned_transfer("umax-read"):
+                umax = float(
+                    self._max_u(s.state["vel"], s.uinf_device())
                 )
+                if s.obstacles:
+                    # the CFL scale must see the BODY kinematics
+                    # immediately: at full gait amplitude the tail's
+                    # deformation velocity reaches the advective limit one
+                    # step before it imprints on the measured fluid field
+                    # (blow-up observed at the diffusive-cap dt otherwise)
+                    import jax.numpy as _jnp
+
+                    umax = max(
+                        umax, float(_jnp.max(_jnp.abs(s.state["udef"])))
+                    )
         if not np.isfinite(umax) or umax > cfg.uMax_allowed:
             # NaN must trip the abort too (`NaN > x` is False; code-review r4)
             s.logger.flush()
@@ -226,9 +232,14 @@ class Simulation:
     def advance(self, dt: float) -> None:
         s = self.sim
         self._maybe_dump_save()
+        # ONE sanctioned host->device upload per step: every operator
+        # receives dt as the same device scalar, so the steady-state loop
+        # is provably transfer-clean under jax.transfer_guard("disallow")
+        # (analysis/runtime.py; the sanitizer contract in VALIDATION.md)
+        dt_dev = device_scalar(dt, s.dtype, tag="dt-upload")
         for op in self.pipeline:
             with s.profiler(op.name):
-                op(dt)
+                op(dt_dev)
         if s.pending_parts:
             with s.profiler("SyncQoI"):
                 entry = self._emit_step_pack()
@@ -281,7 +292,9 @@ class Simulation:
         s = self.sim
         vals = entry.get("vals")
         if vals is None:
-            vals = np.asarray(entry["pack"], np.float64)
+            # the designed end-of-step QoI sync of the non-pipelined path
+            with sanctioned_transfer("qoi-read"):
+                vals = np.asarray(entry["pack"], np.float64)
         ob = s.obstacles[0] if s.obstacles else None
         off = 0
         for name, size in entry["layout"]:

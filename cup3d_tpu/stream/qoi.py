@@ -241,6 +241,9 @@ class QoIStream:
         transfer has not landed yet) and apply its entries FIFO."""
         holder = self._inflight.pop(0)
         was_ready = self._ready(holder["batch"])
+        # jax-lint: allow(JX006, the pre-window calls are host
+        # bookkeeping (FIFO pop + readiness poll); the timed np.asarray
+        # read IS the sync, and stall_s/read_s split on was_ready)
         t0 = time.perf_counter()
         vals = np.asarray(holder["batch"], np.float64)
         elapsed = time.perf_counter() - t0

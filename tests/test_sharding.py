@@ -37,7 +37,9 @@ def test_sharded_step_matches_single_device():
     dt = jnp.float32(2e-3)
     uinf = jnp.zeros(3, jnp.float32)
 
-    step1 = make_step(grid, nu=1e-3, solver=solver)
+    # donate=False: the same `vel` array feeds both the single-device and
+    # the sharded step below (donation would delete it after this call)
+    step1 = make_step(grid, nu=1e-3, solver=solver, donate=False)
     ref_vel, ref_p = step1(vel, dt, uinf)
 
     mesh = make_mesh(jax.devices()[:8])

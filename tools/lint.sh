@@ -1,0 +1,23 @@
+#!/bin/sh
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/) + bytecode compile
+# of the whole package.  Nonzero exit on any non-baselined lint finding
+# or any syntax error.  Run from the repo root:
+#
+#   tools/lint.sh            # lint the package + bench.py
+#   tools/lint.sh mypath/    # lint specific paths instead
+set -e
+cd "$(dirname "$0")/.."
+
+if [ "$#" -gt 0 ]; then
+    PATHS="$@"
+else
+    PATHS="cup3d_tpu/ bench.py"
+fi
+
+echo "== python -m cup3d_tpu.analysis $PATHS"
+python -m cup3d_tpu.analysis $PATHS -q
+
+echo "== python -m compileall"
+python -m compileall -q cup3d_tpu/ tests/ bench.py
+
+echo "lint.sh: OK"
