@@ -29,6 +29,10 @@ Rule summary (full rationale in ``analysis/rules.py``):
          hot-path function.
 - JX005  float64 dtype literal in device code.
 - JX006  ``time.perf_counter()`` timing window with no device sync.
+- JX007  ``jax.jit`` construction inside a loop body or an
+         adaptation-path function (rebuild/adapt): a fresh jit object
+         per pass/regrid defeats the per-object trace cache — the bug
+         class the capacity-bucketed compiled-step cache removes.
 """
 
 from __future__ import annotations
@@ -56,6 +60,14 @@ HOT_FUNC_RE = re.compile(
 
 #: names that mark a jitted function / its target as a steady-state step
 STEP_SHAPE_RE = re.compile(r"step|mega", re.IGNORECASE)
+
+#: functions that run once per mesh adaptation (JX007): a jax.jit built
+#: here is rebuilt per regrid, defeating jax's per-object trace cache
+ADAPT_FUNC_RE = re.compile(r"rebuild|adapt", re.IGNORECASE)
+
+#: loop constructs whose body re-executes (JX004/JX007)
+LOOP_NODES = (ast.For, ast.While, ast.ListComp, ast.SetComp,
+              ast.DictComp, ast.GeneratorExp)
 
 #: host->device constructors relevant to JX004
 JNP_CONSTRUCTORS = frozenset(
@@ -319,6 +331,8 @@ class FileLint:
                 self._check_host_sync(func, qualname)       # JX001
                 self._check_loop_construction(func, qualname)  # JX004
             self._check_jit_sites(func, qualname)           # JX002
+            if hot_module:
+                self._check_jit_in_regrid_path(func, qualname)  # JX007
             if id(func) in jitted:
                 self._check_traced_control_flow(            # JX003
                     func, qualname, jitted[id(func)]
@@ -492,6 +506,56 @@ class FileLint:
                     "step-shaped jax.jit without donate_argnums: the "
                     "state buffers are copied instead of updated in "
                     "place",
+                )
+
+    # -- JX007 -------------------------------------------------------------
+
+    def _check_jit_in_regrid_path(self, func: ast.AST, qualname: str) -> None:
+        """jax.jit construction per-regrid or per-loop-pass: the exact
+        bug class capacity bucketing removes (sim/amr.py compiled-step
+        cache).  Fires on a jit-construction call that is (a) inside a
+        loop/comprehension body, or (b) anywhere in a function whose
+        qualname marks it as an adaptation-path rebuild."""
+        in_adapt = bool(ADAPT_FUNC_RE.search(qualname))
+
+        def is_jit_construction(node: ast.AST) -> bool:
+            return (
+                isinstance(node, ast.Call)
+                and (_jit_target(node) is not None
+                     or _is_partial_of_jit(node))
+            )
+
+        loop_hits: Set[int] = set()
+        for loop in _walk_shallow(func):
+            if not isinstance(loop, LOOP_NODES):
+                continue
+            stack = list(ast.iter_child_nodes(loop))
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)
+                ):
+                    continue  # nested defs get their own visit
+                if is_jit_construction(node):
+                    loop_hits.add(id(node))
+                    self._emit(
+                        "JX007", node, qualname,
+                        "jax.jit built inside a loop body creates a "
+                        "fresh (cold-cache) jit object every pass; "
+                        "hoist it and reuse, or cache by shape bucket",
+                    )
+                stack.extend(ast.iter_child_nodes(node))
+        if not in_adapt:
+            return
+        for node in _walk_shallow(func):
+            if is_jit_construction(node) and id(node) not in loop_hits:
+                self._emit(
+                    "JX007", node, qualname,
+                    "jax.jit built on the adaptation path recompiles "
+                    "every regrid even when shapes match (per-object "
+                    "trace cache); build once and cache by bucket "
+                    "(sim/amr.py compiled-step cache)",
                 )
 
     # -- JX003 -------------------------------------------------------------

@@ -77,6 +77,19 @@ RULES: Dict[str, Rule] = {
             "before its start and before its closing read.",
         ),
         Rule(
+            "JX007",
+            "jax.jit construction inside an adaptation/step loop",
+            "Creating a jax.jit wrapper inside a loop, or inside a "
+            "function that runs per mesh adaptation (rebuild/adapt "
+            "paths), makes a FRESH jit object each pass — jax's trace "
+            "cache is per-object, so every regrid recompiles every step "
+            "function even when all shapes match.  Measured on amr_tgv: "
+            "5.50 s max step against a 0.118 s median (BENCH_r05).  "
+            "Build jits once and cache them keyed on the shape bucket "
+            "(sim/amr.py compiled-step cache), or pass changing data as "
+            "traced arguments.",
+        ),
+        Rule(
             "JX005",
             "float64 dtype literal in device code",
             "A bare float64 dtype in device code either doubles bandwidth "

@@ -134,6 +134,33 @@ jax.tree_util.register_pytree_node(
 )
 
 
+# ---------------------------------------------------------------------------
+# cross-instance table memo: gather tables are pure functions of the
+# (leaves, extent, bc, bs, width) topology, but adaptation builds a NEW
+# BlockGrid every re-layout, so the per-instance caches below never hit
+# across regrids.  Ping-pong regrids (A -> B -> A, the steady-state AMR
+# common case) hit this module-level LRU instead and skip the whole host
+# table build (the dominant host cost of a regrid after bucketing makes
+# the device side retrace-free).
+# ---------------------------------------------------------------------------
+
+_TABLE_MEMO: "dict" = {}
+_TABLE_MEMO_CAP = 6
+
+
+def _memo_get(key):
+    hit = _TABLE_MEMO.pop(key, None)
+    if hit is not None:
+        _TABLE_MEMO[key] = hit  # move-to-back (LRU)
+    return hit
+
+
+def _memo_put(key, val):
+    _TABLE_MEMO[key] = val
+    while len(_TABLE_MEMO) > _TABLE_MEMO_CAP:
+        _TABLE_MEMO.pop(next(iter(_TABLE_MEMO)))
+
+
 class BlockGrid:
     """Geometry + topology of one AMR forest snapshot.
 
@@ -185,6 +212,19 @@ class BlockGrid:
             self._int_maps[l][i, j, k] = True
 
         self._lab_cache: Dict[int, LabTables] = {}
+        self._sig = None
+
+    @property
+    def signature(self):
+        """Hashable identity of this topology (leaves + extent + bc + bs)
+        — the memo key for gather-table builds and the driver's padded
+        bucket artifacts (sim/amr.py)."""
+        if self._sig is None:
+            self._sig = (
+                self.bs, self.extent, tuple(b.value for b in self.bc),
+                self.tree.cfg.level_max, tuple(self.keys),
+            )
+        return self._sig
 
     # -- geometry ----------------------------------------------------------
 
@@ -213,10 +253,16 @@ class BlockGrid:
 
     def lab_tables(self, width: int) -> LabTables:
         if width not in self._lab_cache:
-            # table constants must stay concrete even if a caller builds a
-            # solver under an active jit trace (cached tracers would leak)
-            with jax.ensure_compile_time_eval():
-                self._lab_cache[width] = self._build_lab_tables(width)
+            mkey = ("lab", width, self.signature)
+            hit = _memo_get(mkey)
+            if hit is None:
+                # table constants must stay concrete even if a caller
+                # builds a solver under an active jit trace (cached
+                # tracers would leak)
+                with jax.ensure_compile_time_eval():
+                    hit = self._build_lab_tables(width)
+                _memo_put(mkey, hit)
+            self._lab_cache[width] = hit
         return self._lab_cache[width]
 
     def face_tables(self, width: int):
@@ -225,10 +271,15 @@ class BlockGrid:
         compatible with LabTables for every ops/amr_ops.py consumer."""
         key = ("faces", width)
         if key not in self._lab_cache:
-            from cup3d_tpu.grid.faces import build_face_tables
+            mkey = ("faces", width, self.signature)
+            hit = _memo_get(mkey)
+            if hit is None:
+                from cup3d_tpu.grid.faces import build_face_tables
 
-            with jax.ensure_compile_time_eval():
-                self._lab_cache[key] = build_face_tables(self, width)
+                with jax.ensure_compile_time_eval():
+                    hit = build_face_tables(self, width)
+                _memo_put(mkey, hit)
+            self._lab_cache[key] = hit
         return self._lab_cache[key]
 
     def _cells_per_dim(self, l: int) -> np.ndarray:

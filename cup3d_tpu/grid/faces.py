@@ -52,7 +52,7 @@ import numpy as np
 
 from cup3d_tpu.grid.uniform import BC
 
-__all__ = ["FaceTables", "build_face_tables"]
+__all__ = ["FaceTables", "build_face_tables", "pad_face_tables"]
 
 
 def _cw(w: int) -> int:
@@ -332,6 +332,147 @@ def build_face_tables(grid, width: int) -> FaceTables:
         interp_t=jnp.asarray(Tt),
         interp_n_lo=jnp.asarray(Tn_lo),
         interp_n_hi=jnp.asarray(Tn_hi),
+        fb_rows=fb_rows,
+        fb_tables=fb_tables,
+    )
+
+
+# ---------------------------------------------------------------------------
+# capacity-bucketed padding (grid/bucket.py): same-shape tables across
+# regrids that stay within a bucket, so compiled consumers never retrace
+# ---------------------------------------------------------------------------
+
+
+def pad_face_tables(t: FaceTables, grid, cap: int) -> FaceTables:
+    """Pad ``t`` (built for ``grid``, ``grid.nb`` real blocks) to block
+    capacity ``cap`` (> nb) with INERT rows, bucketing every auxiliary
+    row count up its own ladder (grid/bucket.py).
+
+    Inertness: padding blocks' face sources point at the zero sentinel
+    (their labs assemble to 0); padded shadow-group rows restrict zeros
+    into padded shadow slots; padded coarse-face rows interpolate zeros
+    and write them into the last padding block (``cap - 1``), whose lab
+    is zero anyway; padded fallback rows gather the sentinel and write
+    into the same dump block.  Two topologies with equal bucketed shapes
+    produce tree-equal aux data (``nb``/``shadow_starts``/``n_entries``
+    are capacity-derived), which is what lets jitted consumers reuse
+    their compiled executables across regrids."""
+    from cup3d_tpu.grid import bucket as bk
+    from cup3d_tpu.grid.blocks import LabTables
+
+    nb, bs, w = t.nb, t.bs, t.width
+    if cap <= nb:
+        raise ValueError(f"capacity {cap} must exceed nb={nb} "
+                         "(>= 1 padding block is the dump-target invariant)")
+    tree = grid.tree
+    level_max = tree.cfg.level_max
+    # identical expression to build_face_tables: same shadow ordering
+    internal = sorted(tree.internal_nodes(), key=lambda k: -k[0])
+    counts: dict = {}
+    for k in internal:
+        counts[k[0]] = counts.get(k[0], 0) + 1
+    # one group per possible parent level, deepest first, ALWAYS emitted
+    # (empty levels keep shape (0, 8)) so group ordering is bucket-stable
+    levels = list(range(level_max - 2, -1, -1))
+    caps_g = [bk.count_capacity(counts.get(l, 0)) for l in levels]
+    starts_new, off = [], 0
+    for c in caps_g:
+        starts_new.append(cap + off)
+        off += c
+    n_entries_new = cap + off
+    sent_new = n_entries_new
+
+    # old entry index -> padded entry index
+    remap = np.empty(t.n_entries + 1, np.int64)
+    remap[:nb] = np.arange(nb)
+    level_pos = dict(zip(levels, starts_new))
+    seen: dict = {}
+    for i, key in enumerate(internal):
+        l = key[0]
+        o = seen.get(l, 0)
+        seen[l] = o + 1
+        remap[nb + i] = level_pos[l] + o
+    remap[t.n_entries] = sent_new
+
+    present = sorted({k[0] for k in internal}, reverse=True)
+    child_new = []
+    for li, l in enumerate(levels):
+        cnt = counts.get(l, 0)
+        rows = np.full((caps_g[li], 8), sent_new, np.int64)
+        if cnt:
+            old = np.asarray(t.child_idx[present.index(l)], np.int64)
+            rows[:cnt] = remap[old]
+        child_new.append(jnp.asarray(rows, jnp.int32))
+
+    src_new = np.full((6, cap), sent_new, np.int64)
+    src_new[:, :nb] = remap[np.asarray(t.src, np.int64)]
+    bmask_new = np.zeros((6, cap), bool)
+    bmask_new[:, :nb] = np.asarray(t.bmask)
+
+    dump_row = cap - 1  # guaranteed padding block
+    cf_rows_new, cf_src_new, cf_toff_new = [], [], []
+    for f in range(6):
+        rows = np.asarray(t.cf_rows[f], np.int64)
+        n = rows.shape[0]
+        c = bk.count_capacity(n)
+        r2 = np.full(c, dump_row, np.int64)
+        s2 = np.full((c, 8), sent_new, np.int64)
+        o2 = np.zeros((c, 2), np.int64)
+        if n:
+            r2[:n] = rows
+            s2[:n] = remap[np.asarray(t.cf_src[f], np.int64)]
+            o2[:n] = np.asarray(t.cf_toff[f], np.int64)
+        cf_rows_new.append(jnp.asarray(r2, jnp.int32))
+        cf_src_new.append(jnp.asarray(s2, jnp.int32))
+        cf_toff_new.append(jnp.asarray(o2, jnp.int32))
+
+    fb_rows = fb_tables = None
+    if t.fb_rows is not None:
+        old_rows = np.asarray(t.fb_rows, np.int64)
+        n = old_rows.shape[0]
+        c = bk.count_capacity(n)
+        fb_rows = jnp.asarray(
+            bk.pad_rows(old_rows, c, fill=dump_row), jnp.int32
+        )
+        tb = t.fb_tables
+        cell_sent_old = nb * bs**3
+        cell_sent_new = cap * bs**3
+
+        def _remap_cells(idx):
+            v = np.asarray(idx, np.int64).copy()
+            v[v >= cell_sent_old] = cell_sent_new
+            return bk.pad_rows(v, c, fill=cell_sent_new)
+
+        fb_tables = LabTables(
+            width=tb.width,
+            ghost_xyz=tb.ghost_xyz,
+            g_idx=jnp.asarray(_remap_cells(tb.g_idx), jnp.int32),
+            g_w=jnp.asarray(bk.pad_rows(tb.g_w, c)),
+            g_sign=jnp.asarray(bk.pad_rows(tb.g_sign, c, fill=1.0)),
+            mask_coarse=jnp.asarray(
+                bk.pad_rows(tb.mask_coarse, c, fill=False)
+            ),
+            s_idx=jnp.asarray(_remap_cells(tb.s_idx), jnp.int32),
+            s_w=jnp.asarray(bk.pad_rows(tb.s_w, c)),
+            s_sign=jnp.asarray(bk.pad_rows(tb.s_sign, c, fill=1.0)),
+            interp_w=tb.interp_w,
+            any_coarse=tb.any_coarse,
+        )
+
+    return FaceTables(
+        width=w, bs=bs, nb=cap,
+        child_idx=tuple(child_new),
+        shadow_starts=tuple(starts_new),
+        n_entries=n_entries_new,
+        src=jnp.asarray(src_new, jnp.int32),
+        bmask=jnp.asarray(bmask_new),
+        bsign=t.bsign,
+        cf_rows=tuple(cf_rows_new),
+        cf_src=tuple(cf_src_new),
+        cf_toff=tuple(cf_toff_new),
+        interp_t=t.interp_t,
+        interp_n_lo=t.interp_n_lo,
+        interp_n_hi=t.interp_n_hi,
         fb_rows=fb_rows,
         fb_tables=fb_tables,
     )

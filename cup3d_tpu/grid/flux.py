@@ -144,6 +144,41 @@ def build_flux_tables(grid) -> FluxTables:
     )
 
 
+def pad_flux_tables(t: FluxTables, bs: int, cap: int) -> FluxTables:
+    """Capacity-bucketed padding (grid/bucket.py): round the correction
+    row count up its ladder with INERT rows so the table shapes are
+    stable across regrids that stay within a bucket.
+
+    Padding rows carry ``inv_hc = 0`` (their correction is exactly 0)
+    and scatter into cell 0 of the last padding block (``cap - 1``,
+    guaranteed to exist by the strict block-capacity ladder), so real
+    cells are never touched — not even by a signed zero.  Empty tables
+    stay empty (a no-coarse-face topology is its own bucket class)."""
+    n = int(t.ncorr)
+    if n == 0:
+        return t
+    from cup3d_tpu.grid import bucket as bk
+
+    c = bk.count_capacity(n)
+    if c == n:
+        return t
+    dump_cell = (cap - 1) * bs**3
+    dump_flux = (cap - 1) * 6 * bs * bs
+    return FluxTables(
+        tgt_cell=jnp.asarray(
+            bk.pad_rows(t.tgt_cell, c, fill=dump_cell), jnp.int32
+        ),
+        tgt_flux=jnp.asarray(
+            bk.pad_rows(t.tgt_flux, c, fill=dump_flux), jnp.int32
+        ),
+        src_flux=jnp.asarray(
+            bk.pad_rows(t.src_flux, c, fill=dump_flux), jnp.int32
+        ),
+        inv_hc=jnp.asarray(bk.pad_rows(t.inv_hc, c, fill=0.0)),
+        ncorr=c,
+    )
+
+
 def apply_flux_correction(
     out: jnp.ndarray, fluxes: jnp.ndarray, tab: FluxTables
 ) -> jnp.ndarray:

@@ -257,7 +257,10 @@ def build_amr_poisson_solver(
             (grid.h**3).reshape(grid.nb, 1, 1, 1), jnp.float32
         )
     vol_total = jnp.sum(vol) * grid.bs**3
-    h2 = jnp.asarray((grid.h**2).reshape(grid.nb, 1, 1, 1), jnp.float32)
+    # square in f32 AFTER the dtype cast: bit-identical to the dynamic
+    # builder's h_col * h_col (tests/test_bucketing equivalence)
+    h_col = jnp.asarray(grid.h.reshape(grid.nb, 1, 1, 1), jnp.float32)
+    h2 = h_col * h_col
     # corner block: the reference pins block .index == (0,0,0); in the
     # Hilbert-ordered forest that is the leaf covering the domain corner
     slot0 = int(
@@ -266,13 +269,43 @@ def build_amr_poisson_solver(
         )[0]
     ) if mean_constraint in (1, 3) else 0
 
+    # AMR two-level preconditioner (the round-5 uniform win extended to
+    # the forest): tile getZ at the block's own h plus a coarse
+    # correction over the block face graph (krylov.BlockGraph).  Gated
+    # exactly like the uniform path: pinned-row modes 1/3 would have
+    # their removed nullspace reintroduced by the singular coarse solve
+    # (ADVICE r5), and the sharded forest's _PaddedGeom carries no tree
+    # (distributed coarse solve is future work — VALIDATION.md).
+    graph = None
+    if (krylov.use_coarse_correction() and mean_constraint not in (1, 3)
+            and hasattr(grid, "tree")):
+        graph = krylov.block_graph_tables(grid)
+
     def wmean(x):
         return jnp.sum(x * vol) / vol_total
 
-    def M(r):
-        # per-block getZ with the block's own h^2 (poisson_kernels getZ,
-        # main.cpp:14617-14746); blocks are already bs^3 tiles
-        return krylov.getz_blocks(-h2 * r, cg_iters=precond_iters)
+    def M_of(t, ft):
+        if graph is None:
+            # per-block getZ with the block's own h^2 (poisson_kernels
+            # getZ, main.cpp:14617-14746); blocks are already bs^3 tiles
+            return lambda r: krylov.getz_blocks(-h2 * r,
+                                                cg_iters=precond_iters)
+
+        def M(r):
+            # multiplicative two-level: coarse first, then the tile
+            # solve on the coarse-corrected residual (the lanes-layout
+            # scheme of make_twolevel_preconditioner_lanes, with the
+            # analytic tile-face A zc replaced by one full refluxed
+            # Laplacian — correct on any forest topology)
+            zc = krylov.coarse_correct_blocks(r, vol, graph)
+            zf = jnp.broadcast_to(
+                zc[:, None, None, None], r.shape
+            ).astype(r.dtype)
+            r2 = r - laplacian_blocks(grid, zf, t, ft)
+            return krylov.getz_blocks(-h2 * r2,
+                                      cg_iters=precond_iters) + zf
+
+        return M
 
     def A_of(t, ft):
         if mean_constraint == 1:
@@ -306,9 +339,88 @@ def build_amr_poisson_solver(
             # callers pass the cold RHS norm (see krylov.bicgstab)
             rnorm_ref = jnp.sqrt(jnp.sum(b * b, dtype=jnp.float32))
         x, rnorm, k = krylov.bicgstab(
-            A_of(t, ft), b, M=M, x0=x0,
+            A_of(t, ft), b, M=M_of(t, ft), x0=x0,
             tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter,
             rnorm_ref=rnorm_ref,
+        )
+        if mean_constraint == 2:
+            x = x - wmean(x)
+        return x * pmask if pmask is not None else x
+
+    return solve
+
+
+def build_amr_poisson_solver_dynamic(
+    bs: int,
+    tol_abs: float = 1e-6,
+    tol_rel: float = 1e-4,
+    maxiter: int = 1000,
+    precond_iters: int = 24,
+    mean_constraint: int = 2,
+):
+    """The bucket-stable variant of build_amr_poisson_solver: EVERY
+    topology-dependent quantity travels as a call argument, so one built
+    solve function serves every regrid of a capacity bucket without
+    retracing (sim/amr.py compiled-step cache).
+
+    Per-call arguments: ``geom`` (a duck-typed grid whose ``h`` is a
+    traced (nb,) array — sim/amr._ArgGeom), ``vol``/``pmask`` (padded
+    (nb,1,1,1) cell volume / real-block mask, 0 on padding), optional
+    ``graph`` (krylov.BlockGraph: enables the two-level preconditioner),
+    and ``slot0`` (traced corner-block slot for the pinned-row modes —
+    a dynamic index, so pin relocation across regrids never retraces).
+    The math is identical to the static builder's."""
+    from cup3d_tpu.ops import krylov
+
+    def solve(rhs, x0=None, tab_arg=None, flux_arg=None, rnorm_ref=None,
+              geom=None, vol=None, pmask=None, graph=None, slot0=None):
+        t, ft = tab_arg, flux_arg
+        h_col = jnp.reshape(
+            jnp.asarray(geom.h, rhs.dtype), (geom.nb, 1, 1, 1)
+        )
+        h2 = h_col * h_col
+        vol_total = jnp.sum(vol) * bs**3
+
+        def wmean(x):
+            return jnp.sum(x * vol) / vol_total
+
+        if slot0 is None:
+            slot0 = 0
+
+        def A(x_):
+            out = laplacian_blocks(geom, x_, t, ft)
+            if mean_constraint == 1:
+                out = out.at[slot0, 0, 0, 0].set(wmean(x_) * vol_total)
+            elif mean_constraint == 3:
+                out = out.at[slot0, 0, 0, 0].set(x_[slot0, 0, 0, 0])
+            return out
+
+        if graph is not None and mean_constraint not in (1, 3):
+            def M(r):
+                zc = krylov.coarse_correct_blocks(r, vol, graph)
+                zf = jnp.broadcast_to(
+                    zc[:, None, None, None], r.shape
+                ).astype(r.dtype)
+                r2 = r - laplacian_blocks(geom, zf, t, ft)
+                return krylov.getz_blocks(-h2 * r2,
+                                          cg_iters=precond_iters) + zf
+        else:
+            def M(r):
+                return krylov.getz_blocks(-h2 * r,
+                                          cg_iters=precond_iters)
+
+        if mean_constraint == 2:
+            b = rhs - wmean(rhs)
+        elif mean_constraint in (1, 3):
+            b = rhs.at[slot0, 0, 0, 0].set(0.0)
+        else:
+            b = rhs
+        b = b * pmask if pmask is not None else b
+        if rnorm_ref is None:
+            rnorm_ref = jnp.sqrt(jnp.sum(b * b, dtype=jnp.float32))
+        x, _, _ = krylov.bicgstab(
+            A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel,
+            maxiter=maxiter, rnorm_ref=rnorm_ref,
         )
         if mean_constraint == 2:
             x = x - wmean(x)

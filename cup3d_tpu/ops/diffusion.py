@@ -218,21 +218,32 @@ def build_amr_helmholtz_solver(
     h2 = jnp.asarray((grid.h**2).reshape(grid.nb, 1, 1, 1), jnp.float32)
     inv_h = 1.0 / jnp.sqrt(h2)
 
-    def solve(u: jnp.ndarray, nudt, tab_arg=None, flux_arg=None
-              ) -> jnp.ndarray:
+    def solve(u: jnp.ndarray, nudt, tab_arg=None, flux_arg=None,
+              geom=None) -> jnp.ndarray:
         # like the Poisson front-end, jitted callers pass the tables as
         # traced ARGUMENTS so they are runtime buffers, not HLO constants
-        # (compile-payload rule; ADVICE r2)
+        # (compile-payload rule; ADVICE r2).  ``geom`` (a bucketed
+        # duck-grid with a TRACED h — sim/amr._ArgGeom) makes the
+        # per-block scale a runtime value too, so one built solve serves
+        # every regrid of a capacity bucket without retracing.
         t = tab if tab_arg is None else tab_arg
         ft = flux_tab if flux_arg is None else flux_arg
-        shift = h2 / nudt  # per-block; reference coefficient -6 - h^2/(nu dt)
+        if geom is None:
+            g_, h2_, inv_h_ = grid, h2, inv_h
+        else:
+            g_ = geom
+            h2_ = jnp.reshape(
+                jnp.asarray(g_.h, u.dtype), (g_.nb, 1, 1, 1)
+            ) ** 2
+            inv_h_ = 1.0 / jnp.sqrt(h2_)
+        shift = h2_ / nudt  # per-block; reference coeff -6 - h^2/(nu dt)
         outs = []
         for c in range(3):
             b = u[..., c]
 
             def A(x, _c=c):
                 return helmholtz_comp_blocks(
-                    grid, x, t, nudt, _c, ft, inv_h
+                    g_, x, t, nudt, _c, ft, inv_h_
                 )
 
             def M(r):

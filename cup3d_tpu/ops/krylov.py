@@ -454,6 +454,177 @@ def use_coarse_correction() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# AMR coarse level: one DOF per block over the forest's face graph
+# ---------------------------------------------------------------------------
+
+#: max face-neighbor entries per block under 26-neighbor 2:1 balance:
+#: 6 faces x up to 4 finer blocks per face
+GRAPH_K = 24
+
+
+class BlockGraph(NamedTuple):
+    """Face-adjacency graph of one forest topology, the coarse space of
+    the AMR two-level preconditioner (the multi-level counterpart of
+    make_coarse_correction_lanes' tile-mean grid).
+
+    ``idx``/``w``: (nb[, pad], K) neighbor slots and couplings (w = 0 on
+    padding entries and padding blocks); ``deg``: (nb[, pad],) row sums.
+    The coarse operator is the SPSD graph Laplacian C z = deg*z - W z,
+    whose nullspace is the constant — consistent with the mean-removed
+    pressure system, exactly like the uniform path's pseudo-inverse.
+
+    NamedTuple => pytree: travels as a traced jit ARGUMENT, so bucketed
+    drivers (sim/amr.py) reuse compiled executables across regrids."""
+
+    idx: jnp.ndarray
+    w: jnp.ndarray
+    deg: jnp.ndarray
+
+
+def block_graph_tables(grid, cap: Optional[int] = None,
+                       dtype=jnp.float32) -> BlockGraph:
+    """Host-build the face graph of ``grid`` (a BlockGrid).
+
+    Couplings are the physical finite-volume face conductances A/d in
+    the convention that makes the graph Laplacian the exact Galerkin
+    P^T A P of the refluxed 7-pt Laplacian for SAME-LEVEL faces (the
+    verified uniform limit: w = bs^2 h with volume-weighted restriction
+    reproduces make_coarse_correction_lanes' bs^2/h^2 operator exactly).
+    Coarse-fine faces use the same A/d rule — shared area (bs h_f)^2
+    over the 1.5 h_f center distance — which is an APPROXIMATION of the
+    interpolated-ghost Galerkin rows there; a preconditioner-grade one
+    (symmetric, positive semidefinite, constant nullspace), documented
+    in VALIDATION.md.  ``cap``: optional bucket capacity to pad to."""
+    tree = grid.tree
+    bs = grid.bs
+    nb = grid.nb
+    idx = np.zeros((nb, GRAPH_K), np.int64)
+    w = np.zeros((nb, GRAPH_K), np.float64)
+    fill = np.zeros(nb, np.int64)
+
+    def add(i, j, wij):
+        k = fill[i]
+        idx[i, k] = j
+        w[i, k] = wij
+        fill[i] = k + 1
+
+    offs2 = [(0, 0), (0, 1), (1, 0), (1, 1)]
+    for s, (l, bi, bj, bk) in enumerate(grid.keys):
+        h = float(grid.h[s])
+        for ax in range(3):
+            t1, t2 = [a for a in range(3) if a != ax]
+            for side in (-1, 1):
+                npos = [bi, bj, bk]
+                npos[ax] += side
+                wp = tree.wrap(l, npos)
+                if wp is None:
+                    continue  # closed face: no coupling (zero-gradient)
+                own = tree.owner_level(l, wp)
+                if own == l:
+                    add(s, grid.slot[(l, *wp)], bs * bs * h)
+                elif own == l - 1:
+                    parent = (l - 1, wp[0] // 2, wp[1] // 2, wp[2] // 2)
+                    # fine side of a coarse-fine face: A = (bs h)^2,
+                    # d = (h + 2h)/2 -> w = bs^2 h / 1.5
+                    add(s, grid.slot[parent], bs * bs * h / 1.5)
+                else:  # own == l + 1: 4 finer blocks, h_f = h/2
+                    hf = 0.5 * h
+                    for o1, o2 in offs2:
+                        fpos = [0, 0, 0]
+                        fpos[ax] = 2 * wp[ax] + (1 if side < 0 else 0)
+                        fpos[t1] = 2 * wp[t1] + o1
+                        fpos[t2] = 2 * wp[t2] + o2
+                        fslot = grid._slot_maps[l + 1][tuple(fpos)]
+                        if fslot < 0:
+                            raise KeyError("fine neighbor missing: "
+                                           "unbalanced tree")
+                        add(s, int(fslot), bs * bs * hf / 1.5)
+    deg = w.sum(axis=1)
+    if cap is not None:
+        from cup3d_tpu.grid import bucket as bk_
+
+        idx = bk_.pad_rows(idx, cap)
+        w = bk_.pad_rows(w, cap)
+        deg = bk_.pad_rows(deg, cap)
+    return BlockGraph(
+        idx=jnp.asarray(idx, jnp.int32),
+        w=jnp.asarray(w, dtype),
+        deg=jnp.asarray(deg, dtype),
+    )
+
+
+def _cg_graph(Cfun: Callable, b: jnp.ndarray, iters: int,
+              rtol: float = 1e-6) -> jnp.ndarray:
+    """Fixed-iteration CG on the (tiny) coarse system — fixed so the
+    preconditioner is a FIXED linear operator (BiCGSTAB requirement) and
+    the graph stays static.
+
+    Two gates make the fixed sweep safe in f32 on the SINGULAR
+    (constant-nullspace) coarse system: updates freeze once the
+    relative residual drops below ``rtol`` (CG iterating past
+    convergence on roundoff noise diverges — measured NaN on a 22-node
+    graph at 32 sweeps), and non-positive curvature directions (noise /
+    nullspace: C is PSD) are skipped."""
+    acc = jnp.promote_types(b.dtype, jnp.float32)
+    dot = lambda a, c: jnp.sum(a * c, dtype=acc)
+    rs0 = dot(b, b)
+
+    def body(_, carry):
+        z, r, p, rs = carry
+        live = rs > (rtol * rtol) * rs0
+        ap = Cfun(p)
+        denom = dot(p, ap)
+        ok = jnp.logical_and(live, denom > 0.0)
+        alpha = jnp.where(ok, rs / jnp.where(ok, denom, 1.0), 0.0)
+        z = z + alpha * p
+        r = r - alpha * ap
+        rs_new = dot(r, r)
+        beta = jnp.where(ok, rs_new / jnp.where(rs > 0, rs, 1.0), 0.0)
+        return z, r, r + beta * p, rs_new
+
+    z0 = jnp.zeros_like(b)
+    z, _, _, _ = jax.lax.fori_loop(0, iters, body, (z0, b, b, rs0))
+    return z
+
+
+def coarse_correct_blocks(r: jnp.ndarray, vol: jnp.ndarray,
+                          graph: BlockGraph, iters: int = 32) -> jnp.ndarray:
+    """Coarse correction over the block graph: volume-weighted restrict
+    the residual to one value per block, solve the graph Laplacian with
+    fixed-iteration CG, return the (nb,) per-block correction (prolonged
+    by constant injection at the caller).
+
+    ``vol`` is the per-cell volume column ((nb,1,1,1); 0 on padding
+    blocks, which keeps their rows exactly 0 through the CG).  The
+    restriction R r = h^3 sum_cells r makes the graph weights of
+    block_graph_tables the exact uniform-limit Galerkin scaling (see
+    there).  CG on the singular-consistent system stays in range(C):
+    conservation of the refluxed Laplacian puts zero volume-weighted
+    mean on every Krylov residual of the mean-removed solve."""
+    rc = jnp.sum(r * vol, axis=(1, 2, 3)).astype(graph.w.dtype)
+    # project the constant nullspace out of the restricted residual (the
+    # uniform path's pseudo-inverse does this spectrally): the outer
+    # residual is mean-free only to f32 roundoff, and CG amplifies an
+    # inconsistent nullspace component through near-zero curvature
+    # directions (measured: NaN without this).  Real blocks carry
+    # deg > 0; padding rows are isolated zero rows and stay untouched.
+    m = (graph.deg > 0).astype(rc.dtype)
+    nreal = jnp.maximum(jnp.sum(m), 1.0)
+
+    def deflate(v):
+        return (v - jnp.sum(v * m) / nreal) * m
+
+    def C(z):
+        return graph.deg * z - jnp.sum(z[graph.idx] * graph.w, axis=-1)
+
+    zc = _cg_graph(C, deflate(rc), iters)
+    # the fine A is the NEGATIVE of the positive graph form (lap x =
+    # sum(nb - c)/h^2), same sign flip as the uniform path's
+    # `t = -t * inv3` (_make_coarse_solve_vec)
+    return -deflate(zc).astype(r.dtype)
+
+
+# ---------------------------------------------------------------------------
 # restarted preconditioned BiCGSTAB
 # ---------------------------------------------------------------------------
 

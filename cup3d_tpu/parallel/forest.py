@@ -304,7 +304,14 @@ class ShardedForest:
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.D = mesh.devices.size
-        self.nbs = -(-grid.nb // self.D)  # ceil
+        # per-shard block count rounds up the capacity ladder
+        # (grid/bucket.py, base 1: small shards stay exact): regrids
+        # whose per-shard count stays within a rung keep every sharded
+        # array shape, bounding allocator churn across re-layouts (the
+        # forest still re-traces — its tables are closures by design)
+        from cup3d_tpu.grid import bucket as bk
+
+        self.nbs = bk.count_capacity(-(-grid.nb // self.D), base=1)
         self.nb_pad = self.nbs * self.D
         self.geom = _PaddedGeom(grid, self.nb_pad)
         self.block_sharding = NamedSharding(mesh, P(self.axis))

@@ -7,11 +7,15 @@ pickle — a full blocking field read plus a serial write on the step
 loop.  Here the save splits into:
 
 1. **snapshot** (main thread, non-blocking): ``io.checkpoint
-   .build_payload`` captures device field REFERENCES (immutable, so the
-   snapshot stays consistent while stepping continues) and all host
-   scalars; obstacles are deep-frozen via a pickle round trip because
-   their host-side kinematic state keeps mutating; every field starts a
-   ``copy_to_host_async`` so the transfers overlap subsequent steps;
+   .build_payload`` captures the device fields and all host scalars;
+   each device field is snapshotted into a FRESH buffer (``jnp.copy``,
+   an async on-device copy) — the step jits donate their state buffers
+   (JX002), so holding the live reference would hand the writer thread
+   a deleted array whenever the next step lands before the D2H copy
+   (a measured, order-dependent flake).  Obstacles are deep-frozen via
+   a pickle round trip because their host-side kinematic state keeps
+   mutating; every snapshot then starts a ``copy_to_host_async`` so
+   the transfers overlap subsequent steps;
 2. **write** (background thread): materialize the landed copies and
    pickle the exact ``io/checkpoint.py`` payload (same FORMAT_VERSION,
    same keys), so ``io.checkpoint.load_checkpoint`` restores these
@@ -58,11 +62,20 @@ class AsyncCheckpointer:
             pickle.dumps(payload["obstacles"],
                          protocol=pickle.HIGHEST_PROTOCOL)
         )
-        for v in payload["fields"].values():
-            try:
-                v.copy_to_host_async()
-            except Exception:
-                pass  # numpy fields / platforms without async copies
+        fields = {}
+        for k, v in payload["fields"].items():
+            if hasattr(v, "copy_to_host_async"):  # a live device array
+                import jax.numpy as jnp  # deferred: import-light module
+
+                # donation-proof snapshot: the step jits donate their
+                # state buffers, so the writer must own a fresh copy
+                v = jnp.copy(v)
+                try:
+                    v.copy_to_host_async()
+                except Exception:
+                    pass  # platforms without async copies
+            fields[k] = v
+        payload["fields"] = fields
         if path is None:
             path = checkpoint_path(
                 driver.cfg.path4serialization, payload["step"]

@@ -54,7 +54,9 @@ def test_two_fish_swim(fish_sim):
 
 def test_interface_blocks_at_finest_level(fish_sim):
     sim = fish_sim
-    chi = np.asarray(fish_sim.state["chi"])
+    # state rides bucket-padded (sim/amr.py module doc); unpad to the
+    # grid's real blocks before per-block indexing
+    chi = np.asarray(sim._unpad(fish_sim.state["chi"]))
     band = (chi > 0.01) & (chi < 0.99)
     touched = band.reshape(sim.grid.nb, -1).any(axis=1)
     assert touched.any()
@@ -72,10 +74,14 @@ def test_divergence_gate(fish_sim):
     from cup3d_tpu.ops import amr_ops
 
     g = sim.grid
-    vlab = sim._tab1.assemble_vector(sim.state["vel"], g.bs)
-    d = np.abs(np.asarray(amr_ops.div_blocks(g, vlab, sim._tab1.width)))
+    # unpadded view on the grid's own (unpadded) tables: the driver's
+    # bucket-padded tables expect capacity-sized fields
+    tab = g.face_tables(1)
+    vel = sim._unpad(sim.state["vel"])
+    vlab = tab.assemble_vector(vel, g.bs)
+    d = np.abs(np.asarray(amr_ops.div_blocks(g, vlab, tab.width)))
     assert np.all(np.isfinite(d))
-    chi = np.asarray(sim.state["chi"])
+    chi = np.asarray(sim._unpad(sim.state["chi"]))
     fluid_blocks = chi.reshape(g.nb, -1).max(axis=1) < 1e-6
     assert fluid_blocks.any()
     umax = float(sim._maxu(sim.state["vel"], sim.uinf_device()))

@@ -23,7 +23,7 @@ def test_amr_tgv_runs_and_projects(tmp_path):
     # vorticity of TGV is O(1): with Rtol=0.5 some blocks must refine
     assert s.grid.nb > 8
     s.simulate()
-    vel = s.state["vel"]
+    vel = s._unpad(s.state["vel"])  # state rides bucket-padded
     assert bool(jnp.all(jnp.isfinite(vel)))
     # divergence after projection
     from cup3d_tpu.grid.blocks import assemble_vector_lab
@@ -48,7 +48,7 @@ def test_amr_grid_converges_onto_sphere(tmp_path):
     s.init()
     # the interface band must sit at the finest level
     finest = cfg.levelMax - 1
-    chi = np.asarray(s.state["chi"])
+    chi = np.asarray(s.state["chi"])[: s.grid.nb]
     has_interface = ((chi > 0.01) & (chi < 0.99)).any(axis=(1, 2, 3))
     lv = s.grid.level
     assert has_interface.any()
@@ -73,7 +73,7 @@ def test_amr_naca_runs(tmp_path):
     )
     s = AMRSimulation(cfg)
     s.init()
-    chi = np.asarray(s.state["chi"])
+    chi = np.asarray(s.state["chi"])[: s.grid.nb]
     has_interface = ((chi > 0.01) & (chi < 0.99)).any(axis=(1, 2, 3))
     assert has_interface.any()
     finest = cfg.levelMax - 1

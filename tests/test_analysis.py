@@ -163,6 +163,52 @@ def test_jx005_float64_literal_fires_and_suppresses():
     assert not _failing(src, "cup3d_tpu/io/fixture.py")
 
 
+def test_jx007_jit_in_loop_fires_and_suppresses():
+    src = (
+        "import jax\n"
+        "class D:\n"
+        "    def _prepare(self, fns):\n"
+        "        outs = []\n"
+        "        for f in fns:\n"
+        "            outs.append(jax.jit(f))\n"
+        "        return outs\n"
+    )
+    vs = _failing(src)
+    assert _rules(vs) == {"JX007"}
+    # comprehensions are loops too (the order_dispatch shape)
+    comp = (
+        "import jax\n"
+        "class D:\n"
+        "    def _prepare(self, f):\n"
+        "        return [jax.jit(f, static_argnums=(1,)) for _ in (0, 1)]\n"
+    )
+    assert _rules(_failing(comp)) == {"JX007"}
+    allowed = src.replace(
+        "            outs.append(jax.jit(f))",
+        "            # jax-lint: allow(JX007, built once at init)\n"
+        "            outs.append(jax.jit(f))",
+    )
+    assert not _failing(allowed)
+    # cold module scope: no finding
+    assert not _failing(src, "cup3d_tpu/io/fixture.py")
+
+
+def test_jx007_jit_in_rebuild_fires_and_cached_builder_is_clean():
+    """An adaptation-path function (rebuild/adapt names) may not build
+    jits even outside a lexical loop; a cache-keyed builder is clean."""
+    src = (
+        "import jax\n"
+        "class D:\n"
+        "    def _rebuild(self):\n"
+        "        self._step = jax.jit(self._step_impl, "
+        "donate_argnums=(0,))\n"
+    )
+    vs = _failing(src)
+    assert _rules(vs) == {"JX007"} and vs[0].func == "D._rebuild"
+    clean = src.replace("def _rebuild", "def _build_bucket_executables")
+    assert not _failing(clean)
+
+
 def test_jx006_unsynced_timing_fires_and_sync_is_clean():
     src = (
         "import time\n"

@@ -1,7 +1,9 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/) + bytecode compile
-# of the whole package.  Nonzero exit on any non-baselined lint finding
-# or any syntax error.  Run from the repo root:
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX007
+# incl. the JX007 jit-in-regrid-loop rule) + bytecode compile of the
+# whole package.  Nonzero exit on any non-baselined lint finding or any
+# syntax error.  The shipped tree carries an EMPTY baseline: every
+# finding is inline-annotated with a reason.  Run from the repo root:
 #
 #   tools/lint.sh            # lint the package + bench.py
 #   tools/lint.sh mypath/    # lint specific paths instead
@@ -16,6 +18,11 @@ fi
 
 echo "== python -m cup3d_tpu.analysis $PATHS"
 python -m cup3d_tpu.analysis $PATHS -q
+
+# the regrid-retrace rule on its own line so a JX007 regression is
+# identifiable at a glance in CI logs (ISSUE 3 satellite)
+echo "== python -m cup3d_tpu.analysis --rules JX007 $PATHS"
+python -m cup3d_tpu.analysis --rules JX007 $PATHS -q
 
 echo "== python -m compileall"
 python -m compileall -q cup3d_tpu/ tests/ bench.py
