@@ -157,6 +157,61 @@ def _time_steps_robust(advance, calc_dt, warmup: int, iters: int,
             float(np.percentile(w, 95)))
 
 
+def _obs_delta_fields(m0: dict) -> dict:
+    """Window delta of the obs metrics registry, compacted to nonzero
+    numeric entries (ISSUE 4: each timed window reports ONE registry
+    delta, and the summary's stream/solver scalars derive from it
+    instead of hand-plumbed per-subsystem fields)."""
+    from cup3d_tpu.obs import metrics as obs_metrics
+
+    out = {}
+    for k, v in obs_metrics.delta(m0).items():
+        if isinstance(v, float):
+            v = round(v, 4)
+        if v:
+            out[k] = v
+    return out
+
+
+def _trace_overhead(sim_advance, calc_dt, sync_state, baseline_wall: float,
+                    main_traced: bool, profiler, gate: float = 1.03):
+    """The ISSUE 4 tracing-overhead gate: steady-state step wall with
+    step traces enabled must stay within ``gate`` (3%) of the untraced
+    wall.  Times a second short window with tracing INVERTED from the
+    main window (through a private sink, so a user-requested
+    CUP3D_TRACE=1 trace is never disturbed) and compares."""
+    import tempfile
+
+    from cup3d_tpu.obs import trace as obs_trace
+
+    other_sink = obs_trace.TraceSink(
+        enabled=not main_traced,
+        directory=tempfile.mkdtemp(prefix="cup3d-obsgate-"),
+        max_steps=10_000, xla_annotate=False,
+    )
+    profiler.set_sink(other_sink)
+    try:
+        other, _, _, _ = _time_steps_robust(
+            sim_advance, calc_dt, warmup=2, iters=8, tag="fish_tracegate",
+            sync_state=sync_state,
+        )
+    finally:
+        profiler.set_sink(None)
+        other_sink.close()
+    if main_traced:
+        wall_traced, wall_plain = baseline_wall, other
+    else:
+        wall_traced, wall_plain = other, baseline_wall
+    ratio = wall_traced / max(wall_plain, 1e-12)
+    return {
+        "wall_per_step_traced_s": round(wall_traced, 4),
+        "wall_per_step_untraced_s": round(wall_plain, 4),
+        "trace_overhead_ratio": round(ratio, 4),
+        "trace_overhead_gate": gate,
+        "trace_overhead_gate_ok": bool(ratio <= gate),
+    }
+
+
 def bench_fish_uniform(n_default: int = 128):
     """BASELINE config #2: uniform self-propelled fish, iterative Poisson
     at 1e-6/1e-4 (CUP3D_BENCH_CONFIG=fish256 runs it at 256^3, the closest
@@ -198,10 +253,15 @@ def bench_fish_uniform(n_default: int = 128):
     sim.sim.profiler.totals.clear()
     sim.sim.profiler.counts.clear()
     sim._pack_reader.reset_stats()  # stream counters cover the timed window
+    from cup3d_tpu.obs import metrics as obs_metrics
+    from cup3d_tpu.obs import trace as obs_trace
+
+    m0 = obs_metrics.snapshot()  # one registry delta covers the window
     wall, wall_mean, wall_max, wall_p95 = _time_steps_robust(
         sim.advance, sim.calc_max_timestep, warmup=0, iters=iters,
         tag="fish", sync_state=lambda: sim.sim.state["vel"],
     )
+    obs_delta = _obs_delta_fields(m0)
     stream = sim._pack_reader.snapshot()
     sim.flush_packs()
     cells_s = n**3 / wall
@@ -230,6 +290,14 @@ def bench_fish_uniform(n_default: int = 128):
     # total over the timed window to a per-step figure
     stream_wait_per_step = (
         sim.sim.profiler.totals.get("StreamWait", 0.0) / iters
+    )
+
+    # ISSUE 4 tracing-overhead gate on the headline config: step traces
+    # must cost <= 3% of the steady wall (host dict work only)
+    trace_gate = _trace_overhead(
+        sim.advance, sim.calc_max_timestep,
+        lambda: sim.sim.state["vel"], wall,
+        main_traced=obs_trace.TRACE.enabled, profiler=sim.sim.profiler,
     )
 
     # BiCGSTAB microbenchmark on the production pressure system: advance
@@ -283,6 +351,12 @@ def bench_fish_uniform(n_default: int = 128):
     t_cold = time.perf_counter() - t0
     _, _, k_warm = solve(rhs, p_prev)
     k_warm = int(k_warm)
+    # the iteration-count acceptance numbers live in the registry too,
+    # so one metrics snapshot carries them alongside everything else
+    obs_metrics.gauge("bench.bicgstab_iters", config=f"fish{n}",
+                      kind="cold").set(int(k_cold))
+    obs_metrics.gauge("bench.bicgstab_iters", config=f"fish{n}",
+                      kind="warm").set(k_warm)
 
     gate = _div_gate("fish", n)
     return {
@@ -304,11 +378,23 @@ def bench_fish_uniform(n_default: int = 128):
         # cost no longer hides inside SyncQoI (VERDICT r5, fish256)
         "sync_qoi_s": round(prof.get("SyncQoI", 0.0), 4),
         "stream_wait_s": round(stream_wait_per_step, 4),
-        "stream_bytes": int(stream["bytes_streamed"]
-                            + stream["bytes_staged"]),
-        "stream_stall_s": round(stream["stall_s"], 4),
+        # the stream/solver summary scalars derive from the ONE obs
+        # registry delta over the timed window (ISSUE 4) — the detailed
+        # per-stream dict below is the same collector's live view
+        "stream_bytes": int(
+            obs_delta.get("stream.bytes_streamed{stream=qoi}", 0)
+            + obs_delta.get("stream.bytes_staged{stream=qoi}", 0)
+        ),
+        "stream_stall_s": round(
+            obs_delta.get("stream.stall_s{stream=qoi}", 0.0), 4
+        ),
+        "solver_iters_window": round(
+            obs_delta.get("poisson.iters_hist{driver=uniform}.sum", 0.0)
+        ),
         "stream": {k: (round(v, 4) if isinstance(v, float) else v)
                    for k, v in stream.items()},
+        "obs_delta": obs_delta,
+        **trace_gate,
         "roofline": _lanes_roofline(A, M, rhs),
         "per_operator_mean_s": prof,
         "n": n,
@@ -542,11 +628,15 @@ def bench_amr_tgv():
     iters = 10
     # warmup crosses two grouped-read cycles so their one-time compiles
     # stay out of the timed window
+    from cup3d_tpu.obs import metrics as obs_metrics
+
     compiles_before = rc.total_compiles
+    m0 = obs_metrics.snapshot()
     med, mean, wmax, p95 = _time_steps_robust(
         sim.advance, sim.calc_max_timestep, warmup=9, iters=iters,
         tag="amr_tgv", sync_state=lambda: sim.state["vel"],
     )
+    obs_delta = _obs_delta_fields(m0)
     recompiles_steady = rc.total_compiles - compiles_before
     stream = sim._pack_reader.snapshot()
     total, div_max = sim._divnorms(sim.state["vel"])
@@ -571,6 +661,7 @@ def bench_amr_tgv():
         "stream_bytes": int(stream["bytes_streamed"]
                             + stream["bytes_staged"]),
         "stream_stall_s": round(stream["stall_s"], 4),
+        "obs_delta": obs_delta,
     }
     # dynamic-regrid probe: re-enable adaptation and time a window that
     # crosses adaptation boundaries — with capacity bucketing the
@@ -579,6 +670,7 @@ def bench_amr_tgv():
     # steady wall (the BENCH_r05 5.50 s max-step bug class)
     sim.adapt_enabled = True
     compiles_before = rc.total_compiles
+    m0 = obs_metrics.snapshot()
     rmed, rmean, rmax, rp95 = _time_steps_robust(
         sim.advance, sim.calc_max_timestep, warmup=2, iters=22,
         tag="amr_tgv_regrid", sync_state=lambda: sim.state["vel"],
@@ -591,6 +683,9 @@ def bench_amr_tgv():
         "recompiles": int(rc.total_compiles - compiles_before),
         "blocks": int(sim.grid.nb),
         "bucket_capacity": int(getattr(sim, "_cap", sim.grid.nb)),
+        # regrids/memo-hits/exec-cache traffic over the probe window,
+        # straight from the registry (amr.regrids, bucket.*)
+        "obs_delta": _obs_delta_fields(m0),
     }
     out["roofline"] = _amr_roofline(sim)
     out["bicgstab"] = _amr_iteration_counts(sim)
@@ -643,8 +738,14 @@ def _amr_iteration_counts(sim):
             )[2]
         return int(jax.jit(run)(b))
 
-    return {"iters_tile_only": count(M_tile),
-            "iters_two_level": count(M_two)}
+    from cup3d_tpu.obs import metrics as obs_metrics
+
+    out = {"iters_tile_only": count(M_tile),
+           "iters_two_level": count(M_two)}
+    for kind, v in out.items():
+        obs_metrics.gauge("bench.bicgstab_iters", config="amr_tgv",
+                          kind=kind).set(v)
+    return out
 
 
 def _amr_roofline(sim):
@@ -752,11 +853,15 @@ def bench_two_fish_amr():
     # compile (group concat, scores prefetch, megastep) happens outside
     # the timed window; the window then covers exactly one adaptation.
     iters = 20
+    from cup3d_tpu.obs import metrics as obs_metrics
+
     compiles_before = rc.total_compiles
+    m0 = obs_metrics.snapshot()
     med, mean, wmax, p95 = _time_steps_robust(
         sim.advance, sim.calc_max_timestep, warmup=24, iters=iters,
         tag="two_fish_amr", sync_state=lambda: sim.state["vel"],
     )
+    obs_delta = _obs_delta_fields(m0)
     recompiles_steady = rc.total_compiles - compiles_before
     stream = sim._pack_reader.snapshot()
     sim.flush_packs()
@@ -789,6 +894,7 @@ def bench_two_fish_amr():
         "stream_bytes": int(stream["bytes_streamed"]
                             + stream["bytes_staged"]),
         "stream_stall_s": round(stream["stall_s"], 4),
+        "obs_delta": obs_delta,
     }
 
 
@@ -908,6 +1014,12 @@ def _compact_summary(out: dict) -> dict:
                 "div_fluid": round(float(d.get("div_max_fluid", 0.0)), 4),
                 "gate": d.get("div_fluid_gate"),
                 "ok": d["div_fluid_gate_ok"],
+            }
+        if "trace_overhead_gate_ok" in d:
+            gates[f"{key}_trace_overhead"] = {
+                "ratio": d.get("trace_overhead_ratio"),
+                "gate": d.get("trace_overhead_gate"),
+                "ok": d["trace_overhead_gate_ok"],
             }
         for k in ("sync_qoi_s", "stream_stall_s", "stream_bytes"):
             if k in d:

@@ -23,7 +23,7 @@ from cup3d_tpu.analysis.rules import RULES
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m cup3d_tpu.analysis",
-        description="JAX-aware AST lint (rules JX001-JX006)",
+        description="JAX-aware AST lint (rules JX001-JX008)",
     )
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to lint (default: the package)")

@@ -33,6 +33,10 @@ Rule summary (full rationale in ``analysis/rules.py``):
          adaptation-path function (rebuild/adapt): a fresh jit object
          per pass/regrid defeats the per-object trace cache — the bug
          class the capacity-bucketed compiled-step cache removes.
+- JX008  ``time.perf_counter()`` / manual section timing inside the
+         package but outside ``cup3d_tpu/obs/``: use obs spans, so the
+         measured wall reaches the registry/trace/flight recorder
+         instead of a private counter.
 """
 
 from __future__ import annotations
@@ -338,6 +342,7 @@ class FileLint:
                     func, qualname, jitted[id(func)]
                 )
             self._check_timing_windows(func, qualname)      # JX006
+            self._check_manual_timing(func, qualname)       # JX008
         self._check_dtype_literals()                        # JX005
         return self.violations
 
@@ -690,6 +695,34 @@ class FileLint:
                     v.suppression_reason = reason or None
                 self.violations.append(v)
             start = pc
+
+    # -- JX008 -------------------------------------------------------------
+
+    def _check_manual_timing(self, func: ast.AST, qualname: str) -> None:
+        """``time.perf_counter()`` inside the package but outside the obs
+        layer: a private timing channel the registry/trace/flight layer
+        never sees.  One finding per function (the first read in source
+        order), so one annotation covers a timed section; the obs layer
+        itself is exempt by path, and so are bench.py/validation (they
+        ARE timing harnesses, linted only for the other rules)."""
+        if not self.path.startswith("cup3d_tpu/"):
+            return
+        if self.path.startswith("cup3d_tpu/obs/"):
+            return
+        first = None
+        for node in _walk_shallow(func):
+            if (isinstance(node, ast.Call)
+                    and _call_name(node).endswith("perf_counter")):
+                if first is None or node.lineno < first.lineno:
+                    first = node
+        if first is not None:
+            self._emit(
+                "JX008", first, qualname,
+                "manual section timing outside cup3d_tpu/obs/: use obs "
+                "spans (obs.trace.SpanTimer / the driver profiler) or "
+                "obs metrics so the measurement reaches the registry "
+                "and the step trace",
+            )
 
 
 # -- baseline ---------------------------------------------------------------

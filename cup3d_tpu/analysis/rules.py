@@ -90,6 +90,18 @@ RULES: Dict[str, Rule] = {
             "traced arguments.",
         ),
         Rule(
+            "JX008",
+            "manual section timing outside the obs layer",
+            "time.perf_counter() section timing outside cup3d_tpu/obs/ "
+            "builds a private, invisible telemetry channel: the wall it "
+            "measures never reaches the metrics registry, the step trace, "
+            "or the flight recorder, and the window repeats every JX006 "
+            "sync-honesty hazard from scratch.  Use obs spans "
+            "(obs.trace.SpanTimer / the driver profiler) or obs metrics; "
+            "the annotated exceptions are the stream data-plane's "
+            "stall/read splits, which ARE the registry's data source.",
+        ),
+        Rule(
             "JX005",
             "float64 dtype literal in device code",
             "A bare float64 dtype in device code either doubles bandwidth "

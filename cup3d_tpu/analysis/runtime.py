@@ -37,7 +37,9 @@ from typing import Callable, Dict, Iterable, Optional, Set
 
 #: every sanctioned transfer site that has EVER fired in this process:
 #: tag -> fire count.  The documented allowlist lives in VALIDATION.md;
-#: tests assert observed tags are a subset of it.
+#: tests assert observed tags are a subset of it.  Mirrored into the obs
+#: registry as ``transfers.sanctioned{site=tag}`` counters (round 9) so
+#: one metrics snapshot carries the transfer picture too.
 TRANSFER_SITES: Dict[str, int] = {}
 
 _local = threading.local()
@@ -86,6 +88,9 @@ def sanctioned_transfer(tag: str):
             "point (fix it) or the allowlist in the caller is stale"
         )
     TRANSFER_SITES[tag] = TRANSFER_SITES.get(tag, 0) + 1
+    from cup3d_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.counter("transfers.sanctioned", site=tag).inc()
     import sys
 
     jax = sys.modules.get("jax")
@@ -136,6 +141,12 @@ class RecompileCounter:
                     counter.compiles[name] = (
                         counter.compiles.get(name, 0) + grew
                     )
+                    # compile events are rare by contract: mirror them
+                    # into the obs registry (round 9) so a metrics
+                    # snapshot answers "did anything retrace?"
+                    from cup3d_tpu.obs import metrics as obs_metrics
+
+                    obs_metrics.counter("jit.compiles", fn=name).inc(grew)
             return out
 
         wrapper.__name__ = f"counted({name})"

@@ -149,9 +149,18 @@ _TABLE_MEMO_CAP = 6
 
 
 def _memo_get(key):
+    from cup3d_tpu.obs import metrics as obs_metrics
+
     hit = _TABLE_MEMO.pop(key, None)
     if hit is not None:
         _TABLE_MEMO[key] = hit  # move-to-back (LRU)
+    # hit/miss counters in the obs registry: the regrid-cost story
+    # ("did the ping-pong memo absorb the host table builds?") is one
+    # metrics snapshot away instead of a bench-only observation
+    obs_metrics.counter(
+        "tables.memo_hits" if hit is not None else "tables.memo_misses",
+        kind=key[0] if isinstance(key, tuple) and key else "?",
+    ).inc()
     return hit
 
 

@@ -1,16 +1,16 @@
 """Buffered per-file logging: the reference's ``BufferedLogger``
 (main.cpp:7232-7245, 10300-10345) — named append-only text streams flushed
-every ``flush_every`` writes — plus a tiny wall-clock profiler the reference
-lacks (SURVEY.md section 5 calls for per-operator timing from day one).
+every ``flush_every`` writes — plus the ``Profiler`` compatibility shim
+over the obs span engine (``cup3d_tpu.obs.trace.SpanTimer``).
 """
 
 from __future__ import annotations
 
 import os
-import time
 from collections import defaultdict
-from contextlib import contextmanager
 from typing import Dict, List
+
+from cup3d_tpu.obs.trace import SpanTimer
 
 
 class BufferedLogger:
@@ -38,45 +38,21 @@ class BufferedLogger:
             buf.clear()
 
 
-class Profiler:
-    """Accumulates wall-clock per named section; `report()` returns a table.
+class Profiler(SpanTimer):
+    """Back-compat shim over :class:`cup3d_tpu.obs.trace.SpanTimer`.
 
-    Sections record SELF time: when sections nest, the inner section's
-    wall is excluded from the outer one, so section totals partition the
-    measured wall instead of double-counting.  The load-bearing case is
-    the stream's ``StreamWait`` (device-catch-up backpressure) opening
-    inside the drivers' ``SyncQoI`` — SyncQoI then measures the actual
-    host work of a packed read, not the device time it used to hide
-    (stream/qoi.py, VERDICT r5 fish256)."""
+    Same surface as the pre-obs profiler (``totals``/``counts``/
+    ``report()``, ``with profiler(name):`` sections), same SELF-time
+    semantics (an inner section's wall is excluded from the outer one,
+    so section totals partition the measured wall — the load-bearing
+    case is the stream's ``StreamWait`` opening inside the drivers'
+    ``SyncQoI``; stream/qoi.py, VERDICT r5 fish256), plus two round-9
+    upgrades inherited from the span engine:
 
-    def __init__(self):
-        self.totals: Dict[str, float] = defaultdict(float)
-        self.counts: Dict[str, int] = defaultdict(int)
-        self._stack: List[float] = []  # per-open-section child-time sums
-
-    @contextmanager
-    def __call__(self, name: str):
-        t0 = time.perf_counter()
-        self._stack.append(0.0)
-        try:
-            yield
-        finally:
-            # jax-lint: allow(JX006, profiler sections label WALL phases
-            # by design — SyncQoI/StreamWait exist precisely to attribute
-            # dispatch vs sync time; forcing a device sync per section
-            # would serialize the pipeline being instrumented)
-            elapsed = time.perf_counter() - t0
-            child = self._stack.pop()
-            self.totals[name] += elapsed - child
-            self.counts[name] += 1
-            if self._stack:
-                self._stack[-1] += elapsed
-
-    def report(self) -> str:
-        total = sum(self.totals.values()) or 1.0
-        lines = [f"{'section':<28}{'calls':>8}{'total_s':>12}{'share':>8}"]
-        for name, t in sorted(self.totals.items(), key=lambda kv: -kv[1]):
-            lines.append(
-                f"{name:<28}{self.counts[name]:>8}{t:>12.4f}{t / total:>8.1%}"
-            )
-        return "\n".join(lines)
+    - recursion fix: a section name nesting within ITSELF counts one
+      logical call instead of one per re-entry (the old counter halved
+      ``totals/counts`` per-call means for recursive sections);
+    - every closed section is forwarded to the global trace sink when
+      ``CUP3D_TRACE=1``, so driver profiler sections appear in the
+      Perfetto export for free.
+    """

@@ -1,0 +1,23 @@
+"""Unified telemetry (ISSUE 4): metrics registry, span traces, flight
+recorder — the repo's cross-cutting nervous system.
+
+- :mod:`cup3d_tpu.obs.metrics` — process-global counters / gauges /
+  histograms with labels; ``snapshot()``/``delta()``/``reset()``; the
+  stream data-plane, the analysis sanitizers, the bucket caches, and
+  the solvers all report here.  Host scalars only: the hot path never
+  syncs a device value for telemetry.
+- :mod:`cup3d_tpu.obs.trace` — nested span timing (the engine behind
+  ``io/logging.py``'s Profiler shim), per-step structured JSONL records
+  (``CUP3D_TRACE=1`` -> ``trace.jsonl``), Chrome trace-event export
+  (``trace.pfto.json``, Perfetto-loadable), optional
+  ``jax.profiler.TraceAnnotation`` passthrough (``CUP3D_TRACE_XLA=1``).
+- :mod:`cup3d_tpu.obs.flight` — fixed-size ring of recent step records
+  + solver residual history; dumps a self-contained postmortem JSON on
+  NaN/Inf velocity, dt collapse, or a Poisson solve at its iteration
+  cap.
+
+See README "Observability" for the metric catalog and trace schema, and
+VALIDATION.md round 9 for the pinned contract.
+"""
+
+from cup3d_tpu.obs import flight, metrics, trace  # noqa: F401

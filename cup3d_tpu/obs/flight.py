@@ -1,0 +1,181 @@
+"""NaN/divergence flight recorder: a postmortem artifact instead of a
+stack trace three hours in (ISSUE 4).
+
+Both drivers feed a fixed-size ring of per-step records (step index, t,
+dt, wall, solver iterations/residual, mesh/bucket state — whatever the
+:class:`~cup3d_tpu.obs.trace.StepObserver` collected) plus a parallel
+ring of solver residual history.  Appending is O(1) host work per step,
+so the recorder runs ALWAYS — history must exist from before anyone
+knew the run would die.
+
+``trigger(reason)`` writes one self-contained postmortem JSON:
+
+    {"schema": 1, "reason": ..., "triggered_at_step": ...,
+     "last_known_good_step": ...,      # newest step with finite dt/umax/resid
+     "config": {...},                  # the run's SimulationConfig
+     "state": {...},                   # driver extras (bucket/capacity/...)
+     "steps": [...],                   # the ring, oldest first
+     "residual_history": [...],        # (step, iters, resid) ring
+     "metrics": {...}}                 # full registry snapshot
+
+Trigger sites (wired in sim/simulation.py and sim/amr.py):
+
+- a step producing NaN/Inf max|u| or tripping the runaway-velocity
+  abort (``calc_max_timestep``);
+- the dt policy collapsing to a non-finite or non-positive dt;
+- the Poisson solve burning its iteration cap (detected when the packed
+  solver stats are consumed — asynchronously, like everything else).
+
+One dump per recorder by default (``max_dumps``): the first failure is
+the interesting one, and an abort loop must not spam the disk.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from cup3d_tpu.obs import metrics as _metrics
+
+SCHEMA_VERSION = 1
+
+#: step-record keys whose non-finiteness marks the step as BAD for the
+#: last-known-good bookkeeping
+_HEALTH_KEYS = ("dt", "umax", "resid", "wall_s", "t")
+
+
+def _finite(v) -> bool:
+    try:
+        return math.isfinite(float(v))
+    except (TypeError, ValueError):
+        return True  # non-numeric fields don't vote on health
+
+
+def _jsonable(obj, depth: int = 0):
+    """Best-effort JSON coercion: config dataclasses, numpy scalars,
+    tuples — a postmortem writer must never throw on its payload."""
+    if depth > 6:
+        return repr(obj)
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else repr(obj)
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v, depth + 1) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_jsonable(v, depth + 1) for v in obj]
+    for attr in ("item",):  # numpy / jax scalars
+        if hasattr(obj, attr) and not hasattr(obj, "__len__"):
+            try:
+                return _jsonable(obj.item(), depth + 1)
+            except Exception:
+                break
+    if hasattr(obj, "__dict__") and not callable(obj):
+        try:
+            return {k: _jsonable(v, depth + 1)
+                    for k, v in vars(obj).items()
+                    if not k.startswith("_")}
+        except Exception:
+            pass
+    return repr(obj)
+
+
+class FlightRecorder:
+    """Ring buffer of recent step records + residual histories with a
+    one-shot postmortem dump.
+
+    ``state_probe`` is an optional zero-arg callable returning driver
+    state for the dump (bucket capacity, cache sizes, block count) —
+    called only AT dump time, so it may be as expensive as it likes.
+    """
+
+    def __init__(self, capacity: int = 128, directory: str = ".",
+                 run_config=None,
+                 state_probe: Optional[Callable[[], dict]] = None,
+                 max_dumps: int = 1):
+        self.capacity = int(capacity)
+        self.directory = directory
+        self.run_config = run_config
+        self.state_probe = state_probe
+        self.max_dumps = max_dumps
+        self.steps: deque = deque(maxlen=self.capacity)
+        self.residuals: deque = deque(maxlen=self.capacity)
+        self.last_known_good_step: Optional[int] = None
+        self.dumps_written: List[str] = []
+        self._c_dumps = _metrics.counter("flight.dumps")
+
+    # -- recording (hot path: O(1) host appends) ---------------------------
+
+    def record_step(self, record: dict) -> None:
+        self.steps.append(record)
+        if all(_finite(record[k]) for k in _HEALTH_KEYS if k in record):
+            step = record.get("step")
+            if step is not None:
+                self.last_known_good_step = int(step)
+
+    def note_solver(self, step: int, iters: float, resid: float,
+                    cap: Optional[int] = None) -> None:
+        """Append one (step, iters, resid) sample; a solve that burned
+        its iteration cap (or produced a non-finite residual) triggers a
+        postmortem — the run may limp on, but the evidence is on disk."""
+        self.residuals.append({"step": int(step), "iters": float(iters),
+                               "resid": float(resid)})
+        if cap is not None and iters >= cap > 0:
+            self.trigger("poisson-itercap",
+                         extra={"step": step, "iters": iters,
+                                "resid": resid, "cap": cap})
+        elif not _finite(resid):
+            self.trigger("poisson-nan-residual",
+                         extra={"step": step, "iters": iters})
+
+    # -- postmortem --------------------------------------------------------
+
+    def trigger(self, reason: str, extra: Optional[dict] = None
+                ) -> Optional[str]:
+        """Write the postmortem (once per ``max_dumps``); returns the
+        path, or None when the dump budget is spent."""
+        if len(self.dumps_written) >= self.max_dumps:
+            return None
+        at_step = None
+        if extra and "step" in extra:
+            at_step = extra["step"]
+        elif self.steps:
+            at_step = self.steps[-1].get("step")
+        state = {}
+        if self.state_probe is not None:
+            try:
+                state = self.state_probe()
+            except Exception as e:  # the probe must not kill the dump
+                state = {"probe_error": repr(e)}
+        payload = {
+            "schema": SCHEMA_VERSION,
+            "reason": reason,
+            "wall_time": time.time(),
+            "triggered_at_step": _jsonable(at_step),
+            "last_known_good_step": self.last_known_good_step,
+            "config": _jsonable(self.run_config),
+            "state": _jsonable(state),
+            "extra": _jsonable(extra or {}),
+            "steps": [_jsonable(r) for r in self.steps],
+            "residual_history": list(self.residuals),
+            "metrics": _jsonable(_metrics.snapshot()),
+        }
+        os.makedirs(self.directory or ".", exist_ok=True)
+        tag = at_step if at_step is not None else len(self.steps)
+        path = os.path.join(self.directory,
+                            f"flight_{reason}_{tag}.json")
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+        self.dumps_written.append(path)
+        self._c_dumps.inc()
+        return path
+
+
+def load_postmortem(path: str) -> Dict:
+    """Read a postmortem back (tests, tooling)."""
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,272 @@
+"""Process-global metrics registry: the single place to ask "what has
+this process counted so far?" (ISSUE 4 tentpole).
+
+PRs 1-3 scattered telemetry across ``stream/`` (bytes/stall/inflight
+dicts), ``analysis/runtime.py`` (``TRANSFER_SITES``, recompile counts),
+``ops/krylov.py`` consumers (BiCGSTAB iteration counts read out of
+bench), and ad-hoc ``bench.py`` fields.  This module gives every one of
+those a named home:
+
+- :class:`Counter` — monotonically increasing float/int (events, bytes,
+  cache hits).  ``inc(n)`` is one attribute add on a host float: cheap
+  enough for the step loop, and by construction performs NO device sync
+  (values entering the registry must already be host scalars — the
+  JX001/JX006 lint and the transfer guard keep it that way).
+- :class:`Gauge` — last-written value (bucket capacity, last solver
+  iteration count).
+- :class:`Histogram` — count/sum/min/max/last of observations (solver
+  iterations, stall seconds) without storing samples.
+
+Metrics are keyed by ``(name, labels)``; ``counter("stream.bytes",
+stream="qoi")`` returns the same object on every call, so hot paths
+fetch their metric once and hold it.  ``snapshot()`` flattens everything
+to ``{"name{label=value}": number}``; ``delta(prev)`` subtracts two
+snapshots (window accounting: bench derives its per-window counters
+from one registry delta instead of hand-plumbed fields).
+
+Subsystems that already keep per-instance counter dicts (the stream
+data-plane's ``stats``) register a **collector**: a zero-arg callable
+(held by weakref owner, so dead instances drop out) whose dict is merged
+into every snapshot.  That keeps per-instance semantics where tests
+rely on them while the registry stays the one query surface.
+
+This module deliberately imports neither jax nor numpy: it must stay
+importable (and cheap) from anywhere, including the analysis layer.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
+
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, labels: Dict[str, object]) -> _Key:
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+def flat_name(name: str, labels: Dict[str, object]) -> str:
+    """The snapshot key format: ``name{k=v,...}`` (labels sorted)."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+class _Metric:
+    kind = "metric"
+
+    def __init__(self, name: str, labels: Dict[str, object]):
+        self.name = name
+        self.labels = dict(labels)
+        self.flat = flat_name(name, labels)
+
+    def reset(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def sample(self) -> Dict[str, float]:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic event/byte counter (host-side add; no device syncs)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: Dict[str, object]):
+        super().__init__(name, labels)
+        self.value: float = 0
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+    def reset(self) -> None:
+        self.value = 0
+
+    def sample(self) -> Dict[str, float]:
+        return {self.flat: self.value}
+
+
+class Gauge(_Metric):
+    """Last-written value (capacity, queue depth, last iteration count)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: Dict[str, object]):
+        super().__init__(name, labels)
+        self.value: float = 0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+    def reset(self) -> None:
+        self.value = 0
+
+    def sample(self) -> Dict[str, float]:
+        return {self.flat: self.value}
+
+
+class Histogram(_Metric):
+    """count/sum/min/max/last of observed host scalars — O(1) state, no
+    stored samples (the flight recorder keeps the recent raw series)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: Dict[str, object]):
+        super().__init__(name, labels)
+        self.reset()
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        self.last = v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+    def reset(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.last: Optional[float] = None
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def sample(self) -> Dict[str, float]:
+        out = {f"{self.flat}.count": float(self.count),
+               f"{self.flat}.sum": float(self.sum)}
+        if self.count:
+            out[f"{self.flat}.min"] = float(self.min)
+            out[f"{self.flat}.max"] = float(self.max)
+            out[f"{self.flat}.last"] = float(self.last)
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create metric store + snapshot/delta/reset.
+
+    Creation takes a lock (rare); the returned metric objects are plain
+    attribute stores mutated lock-free under the GIL (hot path)."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[_Key, _Metric] = {}
+        self._collectors: List[Tuple[object, Callable[[], Dict[str, float]]]] = []
+        self._lock = threading.Lock()
+
+    # -- creation ----------------------------------------------------------
+
+    def _get(self, cls, name: str, labels: Dict[str, object]) -> _Metric:
+        k = _key(name, labels)
+        m = self._metrics.get(k)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(k)
+                if m is None:
+                    m = cls(name, labels)
+                    self._metrics[k] = m
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {flat_name(name, labels)!r} already registered "
+                f"as {m.kind}, requested {cls.kind}"
+            )
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    # -- collectors --------------------------------------------------------
+
+    def register_collector(
+        self, fn: Callable[[], Dict[str, float]], owner: object = None
+    ) -> None:
+        """``fn()`` -> {flat_name: number} merged into every snapshot.
+        ``owner`` is held by weakref: when it dies the collector drops out
+        (streams register per-instance ``stats`` views this way).  Equal
+        keys from multiple live collectors SUM (process-wide totals)."""
+        ref = weakref.ref(owner) if owner is not None else (lambda: self)
+        with self._lock:
+            self._collectors.append((ref, fn))
+
+    # -- queries -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        """One flat dict of every metric + every live collector's view."""
+        out: Dict[str, float] = {}
+        for m in list(self._metrics.values()):
+            out.update(m.sample())
+        with self._lock:
+            live = [(r, fn) for r, fn in self._collectors if r() is not None]
+            self._collectors = live
+        for _, fn in live:
+            try:
+                for k, v in fn().items():
+                    out[k] = out.get(k, 0) + v if k in out else v
+            except Exception:  # a dying collector must not kill telemetry
+                continue
+        return out
+
+    def delta(self, prev: Dict[str, float],
+              cur: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+        """Numeric difference of two snapshots (window accounting).  Keys
+        absent from ``prev`` count from 0; gauges difference like
+        everything else (callers wanting absolutes read the snapshot)."""
+        if cur is None:
+            cur = self.snapshot()
+        out = {}
+        for k, v in cur.items():
+            try:
+                out[k] = v - prev.get(k, 0)
+            except TypeError:  # non-numeric collector value
+                out[k] = v
+        return out
+
+    def reset(self) -> None:
+        """Zero every registered metric (collectors keep their own state
+        and are NOT reset — they are per-instance views)."""
+        for m in list(self._metrics.values()):
+            m.reset()
+
+
+#: the process-global registry every subsystem shares
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, **labels) -> Counter:
+    return REGISTRY.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return REGISTRY.gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> Histogram:
+    return REGISTRY.histogram(name, **labels)
+
+
+def snapshot() -> Dict[str, float]:
+    return REGISTRY.snapshot()
+
+
+def delta(prev: Dict[str, float],
+          cur: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+    return REGISTRY.delta(prev, cur)
+
+
+def reset() -> None:
+    REGISTRY.reset()
+
+
+def register_collector(fn: Callable[[], Dict[str, float]],
+                       owner: object = None) -> None:
+    REGISTRY.register_collector(fn, owner)

@@ -1,0 +1,509 @@
+"""Nested span tracing + per-step structured trace records (ISSUE 4).
+
+Three layers, cheapest first:
+
+1. :class:`SpanTimer` — the self-time profiler engine.  This is what
+   ``io/logging.py``'s ``Profiler`` is now a shim over: per-section SELF
+   time (child spans excluded, so section totals partition the measured
+   wall), with the round-9 recursion fix — when a section name nests
+   within ITSELF, only the outermost entry increments ``counts`` (the
+   old profiler counted every re-entry, which inflated the calls column
+   and halved ``totals/counts`` per-call means).  Total attribution is
+   unchanged: re-entries contribute self time to the same name exactly
+   once.  When the global sink is enabled every closed span is also
+   forwarded as a trace event; when it is disabled the overhead is the
+   same dict arithmetic the old profiler paid.
+
+2. :class:`TraceSink` — the process-global trace collector, enabled by
+   ``CUP3D_TRACE=1`` (or ``configure()``).  It holds a bounded ring of
+   span events, appends per-step structured records to a bounded
+   JSON-lines file (``trace.jsonl``, written by a background thread so
+   the step loop never blocks on disk — the stream data-plane's
+   writer-thread pattern), and exports everything as Chrome trace-event
+   format (``trace.pfto.json``) loadable in Perfetto (chrome://tracing
+   works too).  ``CUP3D_TRACE_XLA=1`` additionally wraps every span in
+   ``jax.profiler.TraceAnnotation`` so host spans line up with XLA
+   device timelines in xprof captures.
+
+3. :class:`StepObserver` — the driver-facing glue: wraps one ``advance``
+   into a step span, computes the per-step section self-time deltas,
+   carries the latest consumed solver stats (iterations/residual ride
+   the async QoI pack — NO extra device sync), and feeds the flight
+   recorder's ring buffer every step whether or not tracing is on.
+
+Trace record schema (``SCHEMA_VERSION``, pinned in VALIDATION.md round
+9; ``tools/trace_check.py`` validates files against it):
+
+    {"schema": 1, "step": int, "t": float, "dt": float,
+     "wall_s": float,                     # host wall of the advance
+     "solver": {"iters": float, "resid": float, "at_step": int}?,
+     "stream_wait_s": float?,             # stall delta over the step
+     "sections": {name: self_seconds}?,   # only when tracing is on
+     ...driver extras (nb, bucket_capacity, regrid, umax)}
+
+The metrics hot path guarantee: nothing in this module reads a device
+value — every recorded number is a host scalar the caller already had
+(lint rules JX001/JX006/JX008 and the transfer guard enforce it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+from cup3d_tpu.obs import metrics as _metrics
+
+#: bump when the step-record keys/meaning change; tools/trace_check.py
+#: and the VALIDATION.md round-9 contract pin this
+SCHEMA_VERSION = 1
+
+#: required keys of every step record and their types
+STEP_REQUIRED = {"schema": int, "step": int, "t": float, "dt": float,
+                 "wall_s": float}
+
+
+def validate_step_record(rec: dict) -> List[str]:
+    """Schema-check one step record; returns a list of problems (empty =
+    valid).  Shared by the sink (debug), tests, and trace_check."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not dict"]
+    for k, typ in STEP_REQUIRED.items():
+        if k not in rec:
+            problems.append(f"missing required key {k!r}")
+        elif typ is float:
+            if not isinstance(rec[k], (int, float)) or isinstance(
+                rec[k], bool
+            ):
+                problems.append(f"{k!r} must be numeric")
+        elif not isinstance(rec[k], typ) or isinstance(rec[k], bool):
+            problems.append(f"{k!r} must be {typ.__name__}")
+    if not problems and rec["schema"] != SCHEMA_VERSION:
+        problems.append(
+            f"schema {rec['schema']} != supported {SCHEMA_VERSION}"
+        )
+    if not problems and rec["step"] < 0:
+        problems.append("step must be >= 0")
+    solver = rec.get("solver")
+    if solver is not None:
+        if not isinstance(solver, dict) or "iters" not in solver:
+            problems.append("solver block must be a dict with 'iters'")
+    sections = rec.get("sections")
+    if sections is not None and not all(
+        isinstance(k, str) and isinstance(v, (int, float))
+        for k, v in sections.items()
+    ):
+        problems.append("sections must map str -> seconds")
+    return problems
+
+
+class _AsyncLineWriter:
+    """Bounded background appender: the step loop hands lines over and
+    never blocks on disk.  Lines buffer in memory and flush to the file
+    every ``flush_every`` records on a single writer thread (the
+    stream/dump.py one-thread-executor pattern); when ``max_lines`` is
+    reached further lines are counted as dropped instead of queued, so a
+    runaway trace cannot exhaust the heap."""
+
+    def __init__(self, path: str, flush_every: int = 64,
+                 max_lines: int = 1_000_000):
+        self.path = path
+        self.flush_every = flush_every
+        self.max_lines = max_lines
+        self.lines_written = 0
+        self.dropped = 0
+        self._buf: List[str] = []
+        self._pool = None
+        self._pending: List = []
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # truncate: one trace file per process run
+        with open(path, "w"):
+            pass
+
+    def write(self, line: str) -> None:
+        if self.lines_written + len(self._buf) >= self.max_lines:
+            self.dropped += 1
+            return
+        self._buf.append(line)
+        if len(self._buf) >= self.flush_every:
+            self._kick()
+
+    def _kick(self) -> None:
+        if not self._buf:
+            return
+        chunk, self._buf = "".join(self._buf), []
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                1, thread_name_prefix="cup3d-trace"
+            )
+        # keep at most one pending append beyond the running one: the
+        # writer is strictly faster than the producer in practice, and a
+        # join here (rare) is disk backpressure, not a device sync
+        while len(self._pending) > 1:
+            self._pending.pop(0).result()
+        try:
+            self._pending.append(self._pool.submit(self._append, chunk))
+        except RuntimeError:
+            # interpreter shutdown already stopped the executor (the
+            # atexit close path): write the tail inline
+            self._append(chunk)
+
+    def _append(self, chunk: str) -> None:
+        with open(self.path, "a") as f:
+            f.write(chunk)
+        self.lines_written += chunk.count("\n")
+
+    def flush(self) -> None:
+        self._kick()
+        pending, self._pending = self._pending, []
+        for fut in pending:
+            fut.result()
+
+    def close(self) -> None:
+        self.flush()
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+
+class TraceSink:
+    """Process-global trace collector (span events + step records).
+
+    Construction reads the environment; ``configure()`` overrides it
+    (tests and tools pass explicit directories).  All span timestamps
+    share one ``perf_counter`` epoch so Perfetto lays every thread on a
+    common axis."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 directory: Optional[str] = None,
+                 max_steps: Optional[int] = None,
+                 max_events: int = 500_000,
+                 xla_annotate: Optional[bool] = None):
+        env = os.environ
+        self.enabled = (env.get("CUP3D_TRACE", "0") not in ("0", "")
+                        if enabled is None else enabled)
+        self.directory = directory or env.get("CUP3D_TRACE_DIR") or "."
+        self.max_steps = (int(env.get("CUP3D_TRACE_MAX", "100000"))
+                          if max_steps is None else max_steps)
+        self.xla_annotate = (env.get("CUP3D_TRACE_XLA", "0") != "0"
+                             if xla_annotate is None else xla_annotate)
+        self.epoch = time.perf_counter()
+        self.events: deque = deque(maxlen=max_events)
+        self.steps_recorded = 0
+        self.steps_dropped = 0
+        self._writer: Optional[_AsyncLineWriter] = None
+        self._lock = threading.Lock()
+        self._annotation_cls = False  # unresolved; None = unavailable
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, enabled: Optional[bool] = None,
+                  directory: Optional[str] = None,
+                  max_steps: Optional[int] = None,
+                  xla_annotate: Optional[bool] = None) -> "TraceSink":
+        """Explicit (re)configuration; closes any open writer so the next
+        record lands in the new location."""
+        self.close()
+        if enabled is not None:
+            self.enabled = enabled
+        if directory is not None:
+            self.directory = directory
+        if max_steps is not None:
+            self.max_steps = max_steps
+        if xla_annotate is not None:
+            self.xla_annotate = xla_annotate
+        self.events.clear()
+        self.steps_recorded = 0
+        self.steps_dropped = 0
+        return self
+
+    def default_directory(self, directory: str) -> None:
+        """Driver hint: adopt ``directory`` unless the user pinned one via
+        CUP3D_TRACE_DIR or configure(), or records already landed."""
+        if (os.environ.get("CUP3D_TRACE_DIR") is None
+                and self._writer is None and self.directory == "."):
+            self.directory = directory
+
+    @property
+    def jsonl_path(self) -> str:
+        return os.path.join(self.directory, "trace.jsonl")
+
+    @property
+    def perfetto_path(self) -> str:
+        return os.path.join(self.directory, "trace.pfto.json")
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, t0: float, dur: float,
+             depth: int = 0) -> None:
+        """One closed span (perf_counter seconds).  Ring-buffered; only
+        called when ``enabled`` (SpanTimer checks)."""
+        self.events.append({
+            "name": name, "ph": "X", "pid": 1,
+            "tid": threading.get_ident() & 0xFFFF,
+            "ts": (t0 - self.epoch) * 1e6, "dur": dur * 1e6,
+            "args": {"depth": depth},
+        })
+
+    def step(self, record: dict, t0: float, dur: float) -> None:
+        """One per-step structured record: JSONL line (async writer) +
+        a step span whose args carry the record (the Perfetto view the
+        acceptance criterion reads solver iters / stream wait from)."""
+        if not self.enabled:
+            return
+        if self.steps_recorded >= self.max_steps:
+            self.steps_dropped += 1
+            return
+        record = dict(record)
+        record["schema"] = SCHEMA_VERSION
+        with self._lock:
+            if self._writer is None:
+                self._writer = _AsyncLineWriter(self.jsonl_path)
+            self._writer.write(json.dumps(record) + "\n")
+        self.steps_recorded += 1
+        self.events.append({
+            "name": "step", "ph": "X", "pid": 1,
+            "tid": threading.get_ident() & 0xFFFF,
+            "ts": (t0 - self.epoch) * 1e6, "dur": dur * 1e6,
+            "args": record,
+        })
+        _metrics.counter("trace.steps").inc()
+
+    # -- XLA passthrough ---------------------------------------------------
+
+    def annotation(self, name: str):
+        """A ``jax.profiler.TraceAnnotation`` for ``name`` when the XLA
+        passthrough is on and jax is importable, else None."""
+        if not (self.enabled and self.xla_annotate):
+            return None
+        if self._annotation_cls is False:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._annotation_cls = TraceAnnotation
+            except Exception:  # pragma: no cover - jax-less envs
+                self._annotation_cls = None
+        cls = self._annotation_cls
+        return cls(name) if cls is not None else None
+
+    # -- export ------------------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON object (Perfetto-loadable)."""
+        return {
+            "traceEvents": list(self.events),
+            "displayTimeUnit": "ms",
+            "metadata": {"schema": SCHEMA_VERSION,
+                         "producer": "cup3d_tpu.obs.trace",
+                         "steps_recorded": self.steps_recorded,
+                         "steps_dropped": self.steps_dropped},
+        }
+
+    def export_chrome(self, path: Optional[str] = None) -> str:
+        path = path or self.perfetto_path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._writer is not None:
+                self._writer.flush()
+
+    def close(self) -> None:
+        """Flush the JSONL writer and, if anything was recorded, write
+        the Perfetto export next to it.  Idempotent; also runs atexit."""
+        with self._lock:
+            w, self._writer = self._writer, None
+        if w is not None:
+            w.close()
+        if self.enabled and (self.events or self.steps_recorded):
+            self.export_chrome()
+
+
+#: the process-global sink (env-configured); drivers and profilers
+#: forward through it.  atexit close() makes `CUP3D_TRACE=1 python
+#: bench.py` leave a complete trace without driver cooperation.
+TRACE = TraceSink()
+
+import atexit  # noqa: E402  (registration must follow TRACE)
+
+atexit.register(TRACE.close)
+
+
+def enabled() -> bool:
+    return TRACE.enabled
+
+
+class SpanTimer:
+    """Self-time span accumulator — the engine behind ``io/logging.py``'s
+    ``Profiler`` shim (which subclasses this unchanged).
+
+    Sections record SELF time: an inner span's wall is excluded from its
+    enclosing span, so section totals partition the measured wall (the
+    load-bearing case is the stream's StreamWait opening inside the
+    drivers' SyncQoI).  Recursion fix (round 9): when a name re-enters
+    itself — directly or through other sections — ``counts[name]`` only
+    advances on the OUTERMOST entry, so ``totals/counts`` stays "wall
+    per logical call" (the old per-entry count halved recursive means);
+    self-time attribution is unchanged and still sums to the outer wall.
+    """
+
+    def __init__(self, sink: Optional[TraceSink] = None):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self._stack: List[float] = []  # per-open-span child-time sums
+        self._active: Dict[str, int] = defaultdict(int)  # recursion depth
+        self._sink = sink  # None -> the process-global TRACE
+
+    @property
+    def sink(self) -> TraceSink:
+        return self._sink if self._sink is not None else TRACE
+
+    def set_sink(self, sink: Optional[TraceSink]) -> None:
+        """Redirect span/step forwarding (None -> the global TRACE).
+        bench.py points a driver at a private sink to measure tracing
+        overhead without disturbing the user's global trace."""
+        self._sink = sink
+
+    @contextmanager
+    def __call__(self, name: str):
+        ann = self.sink.annotation(name)
+        if ann is not None:
+            ann.__enter__()
+        # jax-lint: allow(JX006, span open: the annotation setup above
+        # dispatches nothing; spans label WALL phases by design)
+        t0 = time.perf_counter()
+        self._stack.append(0.0)
+        self._active[name] += 1
+        try:
+            yield
+        finally:
+            # jax-lint: allow(JX006, spans label WALL phases by design —
+            # SyncQoI/StreamWait exist precisely to attribute dispatch vs
+            # sync time; forcing a device sync per span would serialize
+            # the pipeline being instrumented)
+            # jax-lint: allow(JX008, this IS the obs span primitive the
+            # rule points everyone else at)
+            elapsed = time.perf_counter() - t0
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            child = self._stack.pop()
+            self.totals[name] += elapsed - child
+            self._active[name] -= 1
+            if self._active[name] == 0:
+                # outermost entry only: recursive re-entries are part of
+                # the same logical call (the round-9 recursion fix)
+                self.counts[name] += 1
+            if self._stack:
+                self._stack[-1] += elapsed
+            sink = self.sink
+            if sink.enabled:
+                sink.span(name, t0, elapsed, depth=len(self._stack))
+
+    def section_totals(self) -> Dict[str, float]:
+        """Plain-dict copy (StepObserver delta bookkeeping)."""
+        return dict(self.totals)
+
+    def report(self) -> str:
+        total = sum(self.totals.values()) or 1.0
+        lines = [f"{'section':<28}{'calls':>8}{'total_s':>12}{'share':>8}"]
+        for name, t in sorted(self.totals.items(), key=lambda kv: -kv[1]):
+            lines.append(
+                f"{name:<28}{self.counts[name]:>8}{t:>12.4f}{t / total:>8.1%}"
+            )
+        return "\n".join(lines)
+
+
+class StepObserver:
+    """Driver glue: one instance per driver, wrapping each ``advance``.
+
+    Always (tracing on or off): appends a compact step record to the
+    flight recorder's ring and bumps the step counter — that is the
+    whole point of a flight recorder, postmortems need history from
+    BEFORE anyone decided to trace.  When the sink is enabled it
+    additionally computes per-section self-time deltas and emits the
+    full step record (JSONL + step span).
+
+    Solver stats arrive via :meth:`note_solver` from wherever the packed
+    QoI read is consumed — they ride the existing async data-plane, so
+    the hot path never syncs for telemetry."""
+
+    def __init__(self, profiler: SpanTimer, flight=None, stream=None,
+                 kind: str = "uniform"):
+        self.profiler = profiler
+        self.flight = flight
+        self.stream = stream
+        self.kind = kind
+        self._steps = _metrics.counter("sim.steps", driver=kind)
+        self._g_iters = _metrics.gauge("poisson.iters", driver=kind)
+        self._g_resid = _metrics.gauge("poisson.resid", driver=kind)
+        self._h_iters = _metrics.histogram("poisson.iters_hist",
+                                           driver=kind)
+        self.last_solver: Optional[dict] = None
+
+    def note_solver(self, step: int, iters: float, resid: float,
+                    cap: Optional[int] = None) -> None:
+        """Record one consumed (iterations, residual) pair; trips the
+        flight recorder when the solve burned its iteration cap."""
+        self.last_solver = {"iters": float(iters), "resid": float(resid),
+                            "at_step": int(step)}
+        self._g_iters.set(float(iters))
+        self._g_resid.set(float(resid))
+        self._h_iters.observe(float(iters))
+        if self.flight is not None:
+            self.flight.note_solver(step, iters, resid, cap=cap)
+
+    @contextmanager
+    def step(self, step: int, t: float, dt: float, **extra):
+        """Wrap one advance.  ``extra`` lands in the record verbatim
+        (AMR passes nb/bucket_capacity/regrid); the yielded dict accepts
+        late fields from inside the step body."""
+        sink = self.profiler.sink
+        tracing = sink.enabled
+        sec0 = self.profiler.section_totals() if tracing else None
+        stall0 = (self.stream.stats.get("stall_s", 0.0)
+                  if self.stream is not None else 0.0)
+        late: dict = {}
+        # jax-lint: allow(JX006, the pre-step reads above are host dict
+        # bookkeeping; wall_s is the HOST wall of advance by definition)
+        t0 = time.perf_counter()
+        try:
+            yield late
+        finally:
+            # jax-lint: allow(JX006, the step record's wall_s is the
+            # HOST wall of advance by definition — the async dispatch
+            # depth is exactly what the trace visualizes; bench remains
+            # the synced timing source)
+            # jax-lint: allow(JX008, StepObserver IS the obs layer's
+            # step-span implementation)
+            wall = time.perf_counter() - t0
+            self._steps.inc()
+            rec = {"step": int(step), "t": float(t), "dt": float(dt),
+                   "wall_s": wall}
+            rec.update(extra)
+            rec.update(late)
+            if self.stream is not None:
+                rec["stream_wait_s"] = (
+                    self.stream.stats.get("stall_s", 0.0) - stall0
+                )
+            if self.last_solver is not None:
+                rec["solver"] = dict(self.last_solver)
+            if self.flight is not None:
+                self.flight.record_step(rec)
+            if tracing:
+                sec1 = self.profiler.section_totals()
+                rec["sections"] = {
+                    k: round(v - sec0.get(k, 0.0), 6)
+                    for k, v in sec1.items()
+                    if v - sec0.get(k, 0.0) > 0.0
+                }
+                sink.step(rec, t0, wall)

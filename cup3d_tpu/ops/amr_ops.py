@@ -318,7 +318,8 @@ def build_amr_poisson_solver(
             ].set(x_[slot0, 0, 0, 0])
         return lambda x_: laplacian_blocks(grid, x_, t, ft)
 
-    def solve(rhs, x0=None, tab_arg=None, flux_arg=None, rnorm_ref=None):
+    def solve(rhs, x0=None, tab_arg=None, flux_arg=None, rnorm_ref=None,
+              with_stats=False):
         # callers under jit pass the tables as traced ARGUMENTS so they
         # are runtime buffers, not constants embedded in the lowered HLO
         # (see grid/blocks.py pytree registration); the builder's own
@@ -345,8 +346,13 @@ def build_amr_poisson_solver(
         )
         if mean_constraint == 2:
             x = x - wmean(x)
-        return x * pmask if pmask is not None else x
+        x = x * pmask if pmask is not None else x
+        if with_stats:
+            return x, krylov.solver_stats(rnorm, k)
+        return x
 
+    solve.supports_stats = True
+    solve.maxiter = maxiter
     return solve
 
 
@@ -373,7 +379,8 @@ def build_amr_poisson_solver_dynamic(
     from cup3d_tpu.ops import krylov
 
     def solve(rhs, x0=None, tab_arg=None, flux_arg=None, rnorm_ref=None,
-              geom=None, vol=None, pmask=None, graph=None, slot0=None):
+              geom=None, vol=None, pmask=None, graph=None, slot0=None,
+              with_stats=False):
         t, ft = tab_arg, flux_arg
         h_col = jnp.reshape(
             jnp.asarray(geom.h, rhs.dtype), (geom.nb, 1, 1, 1)
@@ -418,14 +425,19 @@ def build_amr_poisson_solver_dynamic(
         b = b * pmask if pmask is not None else b
         if rnorm_ref is None:
             rnorm_ref = jnp.sqrt(jnp.sum(b * b, dtype=jnp.float32))
-        x, _, _ = krylov.bicgstab(
+        x, rnorm, k = krylov.bicgstab(
             A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel,
             maxiter=maxiter, rnorm_ref=rnorm_ref,
         )
         if mean_constraint == 2:
             x = x - wmean(x)
-        return x * pmask if pmask is not None else x
+        x = x * pmask if pmask is not None else x
+        if with_stats:
+            return x, krylov.solver_stats(rnorm, k)
+        return x
 
+    solve.supports_stats = True
+    solve.maxiter = maxiter
     return solve
 
 
@@ -477,6 +489,16 @@ def pressure_rhs_blocks(
     return rhs / dt
 
 
+def solver_supports_stats(solver) -> bool:
+    """True when ``solver`` (or the function under a ``partial``
+    binding) advertises the ``with_stats`` return — the AMR front-ends
+    built in this module do, the sharded forest's does not yet."""
+    if getattr(solver, "supports_stats", False):
+        return True
+    return bool(getattr(getattr(solver, "func", None),
+                        "supports_stats", False))
+
+
 def project_blocks(
     grid: BlockGrid,
     vel: jnp.ndarray,
@@ -488,6 +510,7 @@ def project_blocks(
     udef: Optional[jnp.ndarray] = None,
     p_init: Optional[jnp.ndarray] = None,
     second_order: bool = False,
+    with_stats: bool = False,
 ):
     """Solve lap p = rhs and correct u -= dt grad p.  Returns (u, p).
 
@@ -496,21 +519,36 @@ def project_blocks(
     (main.cpp:15087-15100) is used instead: subtract lap(p_old) from the
     RHS, solve for the *increment*, and add p_old back — algebraically the
     same warm start, but matching the reference's residual bookkeeping.
+
+    ``with_stats`` returns (u, p, stats) with stats the solver's (2,)
+    [residual, iterations] vector (zeros when the solver cannot report —
+    the forest path), so driver call signatures stay uniform.
     """
     bs = grid.bs
     rhs = pressure_rhs_blocks(grid, vel, dt, tab, flux_tab, chi, udef)
     # the warm/increment solves stop relative to the COLD system's RHS
     # norm, so a good start can only cut iterations (krylov.bicgstab)
     ref = jnp.sqrt(jnp.sum(rhs * rhs, dtype=jnp.float32))
+    stats_kw = (
+        {"with_stats": True}
+        if with_stats and solver_supports_stats(solver) else {}
+    )
     if second_order and p_init is not None:
         rhs = rhs - laplacian_blocks(grid, p_init, tab, flux_tab)
-        p = p_init + solver(rhs, None, tab_arg=tab, flux_arg=flux_tab,
-                            rnorm_ref=ref)
+        out = solver(rhs, None, tab_arg=tab, flux_arg=flux_tab,
+                     rnorm_ref=ref, **stats_kw)
+        p, stats = out if stats_kw else (out, None)
+        p = p_init + p
     else:
-        p = solver(rhs, p_init, tab_arg=tab, flux_arg=flux_tab,
-                   rnorm_ref=ref)
+        out = solver(rhs, p_init, tab_arg=tab, flux_arg=flux_tab,
+                     rnorm_ref=ref, **stats_kw)
+        p, stats = out if stats_kw else (out, None)
     plab = tab.assemble_scalar(p, bs)
     gp = grad_blocks(grid, plab, tab.width)
+    if with_stats:
+        if stats is None:
+            stats = jnp.zeros(2, jnp.float32)
+        return vel - dt * gp, p, stats
     return vel - dt * gp, p
 
 

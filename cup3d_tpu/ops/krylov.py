@@ -817,7 +817,8 @@ def build_iterative_solver(
         def M(r):
             return getz_lanes(-h2 * r, cg_iters=precond_iters)
 
-    def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
+              with_stats: bool = False):
         if mean_constraint == 2:
             b = rhs - jnp.mean(rhs)
         else:
@@ -828,14 +829,30 @@ def build_iterative_solver(
         x0t = None if x0 is None else to_lanes(x0, precond_bs)
         # rel tolerance always references the cold system's RHS norm so a
         # warm start can only reduce iterations (see bicgstab docstring)
-        xt, _, _ = bicgstab(
+        xt, rnorm, k = bicgstab(
             A, bt, M=M, x0=x0t, tol_abs=tol_abs, tol_rel=tol_rel,
             maxiter=maxiter, rnorm_ref=jnp.sqrt(_dot(bt, bt)),
         )
         x = from_lanes(xt, rhs.shape)
-        return x - jnp.mean(x) if mean_constraint == 2 else x
+        x = x - jnp.mean(x) if mean_constraint == 2 else x
+        if with_stats:
+            # (final residual norm, iterations) as one device vector —
+            # drivers pack it onto the async QoI read so per-step solver
+            # telemetry costs ZERO extra syncs (obs/trace.py)
+            return x, solver_stats(rnorm, k)
+        return x
 
+    solve.supports_stats = True
+    solve.maxiter = maxiter
     return solve
+
+
+def solver_stats(rnorm, k) -> jnp.ndarray:
+    """(2,) f32 device vector [residual norm, iterations] — the packed
+    per-solve telemetry the obs layer consumes (shared by the uniform
+    and AMR solver front-ends)."""
+    return jnp.stack([jnp.asarray(rnorm, jnp.float32),
+                      jnp.asarray(k, jnp.float32)])
 
 
 def _build_iterative_solver_dense(
@@ -860,14 +877,20 @@ def _build_iterative_solver_dense(
     else:
         A = A0
 
-    def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
+              with_stats: bool = False):
         b = rhs - jnp.mean(rhs) if mean_constraint == 2 else rhs
         if mean_constraint in (1, 3):
             b = b.at[0, 0, 0].set(0.0)
-        x, _, _ = bicgstab(
+        x, rnorm, k = bicgstab(
             A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel,
             maxiter=maxiter, rnorm_ref=jnp.sqrt(_dot(b, b)),
         )
-        return x - jnp.mean(x) if mean_constraint == 2 else x
+        x = x - jnp.mean(x) if mean_constraint == 2 else x
+        if with_stats:
+            return x, solver_stats(rnorm, k)
+        return x
 
+    solve.supports_stats = True
+    solve.maxiter = maxiter
     return solve

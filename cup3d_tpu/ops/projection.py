@@ -33,11 +33,23 @@ def pressure_rhs(grid: UniformGrid, u: jnp.ndarray, dt,
 def project(grid: UniformGrid, u: jnp.ndarray, dt, solver: Callable,
             chi: Optional[jnp.ndarray] = None,
             udef: Optional[jnp.ndarray] = None,
-            p_init: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            p_init: Optional[jnp.ndarray] = None,
+            with_stats: bool = False):
     """Returns (projected velocity, pressure).  ``p_init`` warm-starts an
     iterative solver from the previous step's pressure (ignored by the
-    exact spectral solver)."""
+    exact spectral solver).
+
+    ``with_stats`` (solvers advertising ``supports_stats``, i.e. the
+    iterative front-ends) additionally returns the (2,) [residual,
+    iterations] device vector — packed telemetry for the obs layer, no
+    host sync here."""
     rhs = pressure_rhs(grid, u, dt, chi, udef)
-    p = solver(rhs, p_init)
+    if with_stats and getattr(solver, "supports_stats", False):
+        p, stats = solver(rhs, p_init, with_stats=True)
+    else:
+        p = solver(rhs, p_init)
+        stats = None
     gradp = st.grad(grid.pad_scalar(p, 1), 1, grid.h)
+    if with_stats:
+        return u - dt * gradp, p, stats
     return u - dt * gradp, p

@@ -203,6 +203,23 @@ class AMRSimulation:
 
         self._dumper = AsyncDumper()
         self._checkpointer = AsyncCheckpointer()
+        # round-9 observability (cup3d_tpu/obs/): postmortem ring always
+        # on; step traces under CUP3D_TRACE=1.  Solver stats ride the
+        # packed QoI reads of the host path (the megastep pack layout is
+        # unchanged — pipelined traces carry mesh/stream fields only).
+        from cup3d_tpu.obs import trace as obs_trace
+        from cup3d_tpu.obs.flight import FlightRecorder
+
+        obs_trace.TRACE.default_directory(cfg.path4serialization)
+        self.flight = FlightRecorder(
+            directory=cfg.path4serialization, run_config=cfg,
+            state_probe=self._flight_state,
+        )
+        self._obs = obs_trace.StepObserver(
+            self.profiler, flight=self.flight, stream=self._pack_reader,
+            kind="amr",
+        )
+        self._last_umax = None
         self._uinf_dev = None
         self._collision_hot = False
         # refinement scores dispatched one step EARLY in pipelined mode so
@@ -219,6 +236,26 @@ class AMRSimulation:
         self._solver_core = None
         self._rebuild()
         self._alloc_fields()
+
+    def _flight_state(self) -> dict:
+        """Driver + bucket/capacity state for a flight-recorder
+        postmortem (called only at dump time)."""
+        g = self.grid
+        return {
+            "driver": "amr",
+            "blocks": int(g.nb),
+            "bucket_capacity": int(getattr(self, "_cap", g.nb)),
+            "bucketing": bool(self._bucketing),
+            "levels": sorted(set(int(l) for l in np.asarray(g.level))),
+            "table_memo_entries": len(self._table_memo),
+            "exec_cache_entries": len(self._exec_cache),
+            "step": self.step_idx,
+            "time": self.time,
+            "dt": self.dt,
+            "collision_hot": bool(self._collision_hot),
+            "obstacles": [type(ob).__name__ for ob in self.obstacles],
+            "stream": self._pack_reader.snapshot(),
+        }
 
     # the obstacle classes address their host as `sim`; provide the same
     # attribute surface as SimulationData where they need it
@@ -378,11 +415,14 @@ class AMRSimulation:
                 self._tab3, self._ftab,
                 donate=(0,),  # vel -> vel
             )
+        # with_stats: (vel, p, [resid, iters]) — the stats vector joins
+        # the end-of-step packed QoI read (zeros on the stats-less
+        # forest solver), so solver telemetry never adds a host sync
         self._project = jit_bound(
             lambda vel, dt, chi, udef, p_old, tab1, ftab:
             amr_ops.project_blocks(
                 geom, vel, dt, self._solver, tab1, ftab, chi, udef,
-                p_init=p_old,
+                p_init=p_old, with_stats=True,
             ),
             self._tab1, self._ftab,
             donate=(0, 4),  # vel -> vel, p_old -> p; chi/udef persist
@@ -391,7 +431,7 @@ class AMRSimulation:
             lambda vel, dt, chi, udef, p_old, tab1, ftab:
             amr_ops.project_blocks(
                 geom, vel, dt, self._solver, tab1, ftab, chi, udef,
-                p_init=p_old, second_order=True,
+                p_init=p_old, second_order=True, with_stats=True,
             ),
             self._tab1, self._ftab,
             donate=(0, 4),  # vel -> vel, p_old -> p; chi/udef persist
@@ -511,10 +551,16 @@ class AMRSimulation:
         from cup3d_tpu.grid.flux import pad_flux_tables
         from cup3d_tpu.ops import krylov
 
+        from cup3d_tpu.obs import metrics as obs_metrics
+
         sig = g.signature
         memo = self._table_memo.pop(sig, None)
         if memo is not None:
             self._table_memo[sig] = memo  # move-to-back (LRU)
+        obs_metrics.counter(
+            "bucket.table_memo_hits" if memo is not None
+            else "bucket.table_memo_misses"
+        ).inc()
         if memo is None:
             cap = bk.capacity(g.nb)
             coarse = (krylov.use_coarse_correction()
@@ -582,9 +628,17 @@ class AMRSimulation:
             kw.setdefault("slot0", self._slot0_dev)
             return self._solver_core(rhs, x0, **kw)
 
+        solver.supports_stats = True  # forwards with_stats to the core
+        solver.maxiter = getattr(self._solver_core, "maxiter", None)
         self._solver = solver
         key = self._bucket_key()
         ex = self._exec_cache.get(key)
+        obs_metrics.counter(
+            "bucket.exec_cache_hits" if ex is not None
+            else "bucket.exec_cache_misses"
+        ).inc()
+        obs_metrics.gauge("bucket.capacity").set(self._cap)
+        obs_metrics.gauge("amr.blocks").set(g.nb)
         if ex is None:
             ex = self._build_bucket_executables()
             self._exec_cache[key] = ex
@@ -665,7 +719,7 @@ class AMRSimulation:
                 g_ = geom_of(geo[3])
                 return amr_ops.project_blocks(
                     g_, vel, dt, solver_for(geo), geo[0], geo[2], chi,
-                    udef, p_init=p_old, second_order=so,
+                    udef, p_init=p_old, second_order=so, with_stats=True,
                 )
             project.__name__ = "project_2nd" if so else "project"
             return jax.jit(project, donate_argnums=(0, 4))
@@ -1395,10 +1449,14 @@ class AMRSimulation:
         from the tagging so tests can force arbitrary regrid cycles
         (tests/test_bucketing.py drives refine->coarsen->refine through
         here and asserts the compiled-step cache absorbs them)."""
+        from cup3d_tpu.obs import metrics as obs_metrics
+
         g = self.grid
         plan = ad.adapt(g, states)
         if plan is None:
+            obs_metrics.counter("amr.regrid_noops").inc()
             return False
+        obs_metrics.counter("amr.regrids").inc()
         for k in ("vel", "udef", "chi", "p"):
             self.state[k] = ad.transfer_field(
                 g, plan, self._unpad(self.state[k])
@@ -1548,10 +1606,18 @@ class AMRSimulation:
                         umax,
                         float(jnp.max(jnp.abs(self.state["udef"]))),
                     )
+        self._last_umax = umax  # host float already (both branches)
         if not np.isfinite(umax) or umax > cfg.uMax_allowed:
             # NaN must trip the abort too: `NaN > x` is False, and a NaN
             # umax would otherwise propagate into dt (code-review r4)
             self.logger.flush()
+            # postmortem BEFORE the raise (obs/flight.py): ring, residual
+            # history, bucket/capacity state, last-known-good step
+            self.flight.trigger(
+                "nan-velocity" if not np.isfinite(umax)
+                else "runaway-velocity",
+                extra={"step": self.step_idx, "umax": umax},
+            )
             raise RuntimeError(f"runaway velocity: max|u|={umax:.3g}")
         if cfg.dt > 0:
             self.dt = cfg.dt
@@ -1571,6 +1637,14 @@ class AMRSimulation:
                 self.dt = min(self.dt, 1.03 * prev_dt)
             if cfg.tend > 0:
                 self.dt = min(self.dt, cfg.tend - self.time)
+        if not np.isfinite(self.dt) or self.dt <= 0:
+            # dt policy collapse -> postmortem + abort (obs/flight.py)
+            self.flight.trigger(
+                "dt-collapse",
+                extra={"step": self.step_idx, "dt": self.dt,
+                       "umax": umax},
+            )
+            raise RuntimeError(f"dt policy collapse: dt={self.dt:.3g}")
         if cfg.DLM > 0:
             self.lambda_penal = cfg.DLM / self.dt
         return self.dt
@@ -1610,10 +1684,14 @@ class AMRSimulation:
                 self._dumper.submit(prefix, self.time, self.grid, fields)
 
     def drain_streams(self):
-        """Join all off-critical-path output (pending dumps/checkpoints) —
-        run end, and anything that must observe the files on disk."""
+        """Join all off-critical-path output (pending dumps/checkpoints,
+        trace writer) — run end, and anything that must observe the files
+        on disk."""
+        from cup3d_tpu.obs import trace as obs_trace
+
         self._dumper.wait()
         self._checkpointer.wait()
+        obs_trace.TRACE.flush()
 
     def _log_diagnostics(self):
         """div.txt/energy.txt rows every freqDiagnostics steps — shared by
@@ -1640,10 +1718,28 @@ class AMRSimulation:
             )
 
     def advance(self, dt: float):
-        if self.cfg.pipelined and not self._collision_hot:
-            if self.obstacles:
-                return self.advance_pipelined(dt)
-            return self.advance_pipelined_free(dt)
+        # step span + flight ring around whichever stepping path runs:
+        # the record carries the pre-step topology (nb/bucket) so regrid
+        # and bucket transitions are visible across consecutive records
+        extra = {"nb": int(self.grid.nb)}
+        if self._bucketing and hasattr(self, "_cap"):
+            extra["bucket_capacity"] = int(self._cap)
+        if self._last_umax is not None:
+            extra["umax"] = float(self._last_umax)
+        with self._obs.step(self.step_idx, self.time, dt, **extra) as late:
+            try:
+                if self.cfg.pipelined and not self._collision_hot:
+                    if self.obstacles:
+                        return self.advance_pipelined(dt)
+                    return self.advance_pipelined_free(dt)
+                return self._advance_host(dt)
+            finally:
+                if int(self.grid.nb) != extra["nb"]:
+                    late["regrid"] = True
+                    late["nb_post"] = int(self.grid.nb)
+
+    def _advance_host(self, dt: float):
+        """Non-pipelined stepping (also the collision fallback path)."""
         if self._pack_reader:
             # entering the host path from pipelined mode (collision
             # fallback or mode switch): mirrors must be current and the
@@ -1759,7 +1855,12 @@ class AMRSimulation:
                 if self.step_idx >= self.cfg.step_2nd_start
                 else self._project
             )
-            s["vel"], s["p"] = proj(s["vel"], dt_j, s["chi"], s["udef"], s["p"])
+            s["vel"], s["p"], psolve = proj(
+                s["vel"], dt_j, s["chi"], s["udef"], s["p"]
+            )
+            # [resid, iters] joins the end-of-step packed read: solver
+            # telemetry for the obs layer, no extra transfer
+            self._pending_parts.append(("psolve", psolve))
         if self.obstacles:
             with self.profiler("ComputeForces"):
                 self._compute_forces()
@@ -1993,6 +2094,13 @@ class AMRSimulation:
                     )
             elif name == "umax":
                 self._umax_next = float(seg[0])
+            elif name == "psolve":
+                # consumed up to ~2*read_every steps late: attribute the
+                # stats to the PRODUCING step carried in the entry
+                self._obs.note_solver(
+                    int(entry.get("step", self.step_idx)), seg[1], seg[0],
+                    cap=getattr(self._solver, "maxiter", None),
+                )
         # host frame velocity from the refreshed mirrors (logs/dumps)
         fixed = [ob for ob in self.obstacles if ob.bFixFrameOfRef]
         if fixed:
@@ -2034,6 +2142,13 @@ class AMRSimulation:
                     log_forces(self.logger, i, self.time, ob)
             elif name == "umax":
                 self._umax_next = float(seg[0])
+            elif name == "psolve":
+                # [residual, iterations]: obs gauges + step trace +
+                # flight residual history (itercap trips a postmortem)
+                self._obs.note_solver(
+                    self.step_idx, seg[1], seg[0],
+                    cap=getattr(self._solver, "maxiter", None),
+                )
 
     def _fix_mass_flux(self):
         u_target = 2.0 / 3.0 * self.cfg.uMax_forced

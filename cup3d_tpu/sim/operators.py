@@ -141,6 +141,12 @@ class PressureProjection(Operator):
     def __init__(self, sim: SimulationData):
         super().__init__(sim)
         grid, solver = sim.grid, sim.poisson_solver
+        # iterative solvers surface (residual, iterations) as a device
+        # vector that rides the end-of-step QoI pack — per-step solver
+        # telemetry with zero extra syncs (obs/trace.py).  The exact
+        # spectral solver has no iteration count; its path is unchanged.
+        self._with_stats = bool(getattr(solver, "supports_stats", False))
+        self.solver_maxiter = getattr(solver, "maxiter", None)
 
         # vel and p_old are the step state: donated (JX002 burn-down).
         # chi/udef persist across steps and must NOT be donated.
@@ -148,15 +154,21 @@ class PressureProjection(Operator):
         def _project(vel, chi, udef, dt, p_old):
             # previous pressure warm-starts the iterative solver
             # (main.cpp:15087-15100); the spectral solver ignores it
-            return project(grid, vel, dt, solver, chi, udef, p_init=p_old)
+            return project(grid, vel, dt, solver, chi, udef, p_init=p_old,
+                           with_stats=self._with_stats)
 
         self._project = _project
 
     def __call__(self, dt):
         s = self.sim
-        vel, p = self._project(
+        out = self._project(
             s.state["vel"], s.state["chi"], s.state["udef"], dt, s.state["p"]
         )
+        if self._with_stats:
+            vel, p, stats = out
+            s.pending_parts.append(("psolve", stats))
+        else:
+            vel, p = out
         s.state["vel"] = vel
         s.state["p"] = p
 

@@ -16,6 +16,8 @@ import numpy as np
 
 from cup3d_tpu.analysis.runtime import device_scalar, sanctioned_transfer
 from cup3d_tpu.config import SimulationConfig, parse_factory
+from cup3d_tpu.obs import trace as obs_trace
+from cup3d_tpu.obs.flight import FlightRecorder
 from cup3d_tpu.ops import diagnostics as diag
 from cup3d_tpu.sim import operators as ops
 from cup3d_tpu.sim.data import SimulationData
@@ -48,6 +50,21 @@ class Simulation:
 
         self._dumper = AsyncDumper()
         self._checkpointer = AsyncCheckpointer()
+        # round-9 observability (cup3d_tpu/obs/): the flight recorder's
+        # ring runs ALWAYS (O(1) host appends — postmortems need history
+        # from before the failure); step traces only under CUP3D_TRACE=1.
+        # Solver iteration counts ride the packed QoI read (see
+        # PressureProjection), never a dedicated sync.
+        obs_trace.TRACE.default_directory(cfg.path4serialization)
+        self.flight = FlightRecorder(
+            directory=cfg.path4serialization, run_config=cfg,
+            state_probe=self._flight_state,
+        )
+        self._obs = obs_trace.StepObserver(
+            self.sim.profiler, flight=self.flight,
+            stream=self._pack_reader, kind="uniform",
+        )
+        self._last_umax: Optional[float] = None
 
     # -- setup (reference init(), main.cpp:15163-15178) --------------------
 
@@ -113,6 +130,23 @@ class Simulation:
 
         self.sim.obstacles = make_obstacles(self.sim, parse_factory(content))
 
+    # -- observability -----------------------------------------------------
+
+    def _flight_state(self) -> dict:
+        """Driver state for a flight-recorder postmortem (called only at
+        dump time, so the host reads here are free to be thorough)."""
+        s = self.sim
+        return {
+            "driver": "uniform",
+            "shape": list(s.grid.shape),
+            "step": s.step,
+            "time": s.time,
+            "dt": s.dt,
+            "uinf": [float(v) for v in s.uinf],
+            "obstacles": [type(ob).__name__ for ob in s.obstacles],
+            "stream": self._pack_reader.snapshot(),
+        }
+
     # -- time stepping -----------------------------------------------------
 
     def calc_max_timestep(self) -> float:
@@ -152,9 +186,17 @@ class Simulation:
                     umax = max(
                         umax, float(_jnp.max(_jnp.abs(s.state["udef"])))
                     )
+        self._last_umax = umax  # host float already (both branches)
         if not np.isfinite(umax) or umax > cfg.uMax_allowed:
             # NaN must trip the abort too (`NaN > x` is False; code-review r4)
             s.logger.flush()
+            # postmortem BEFORE the raise: ring contents, residual
+            # history, last-known-good step (obs/flight.py)
+            self.flight.trigger(
+                "nan-velocity" if not np.isfinite(umax)
+                else "runaway-velocity",
+                extra={"step": s.step, "umax": umax},
+            )
             raise RuntimeError(
                 f"runaway velocity: max|u|={umax:.3g} > uMax_allowed={cfg.uMax_allowed}"
             )
@@ -183,6 +225,14 @@ class Simulation:
                 s.dt = min(s.dt, 1.03 * prev_dt)
             if cfg.tend > 0:
                 s.dt = min(s.dt, cfg.tend - s.time)
+        if not np.isfinite(s.dt) or s.dt <= 0:
+            # dt policy collapse: a non-finite or non-positive dt would
+            # loop forever / poison every field — dump and abort
+            self.flight.trigger(
+                "dt-collapse",
+                extra={"step": s.step, "dt": s.dt, "umax": umax},
+            )
+            raise RuntimeError(f"dt policy collapse: dt={s.dt:.3g}")
         # lambda = DLM/dt each step (main.cpp:15302-15303)
         if cfg.DLM > 0:
             s.lambda_penal = cfg.DLM / s.dt
@@ -224,39 +274,47 @@ class Simulation:
                 self._dumper.submit(prefix, s.time, s.grid, fields)
 
     def drain_streams(self) -> None:
-        """Join all off-critical-path output (pending dumps/checkpoints) —
-        run end, and anything that must observe the files on disk."""
+        """Join all off-critical-path output (pending dumps/checkpoints,
+        trace writer) — run end, and anything that must observe the files
+        on disk."""
         self._dumper.wait()
         self._checkpointer.wait()
+        obs_trace.TRACE.flush()
 
     def advance(self, dt: float) -> None:
         s = self.sim
-        self._maybe_dump_save()
-        # ONE sanctioned host->device upload per step: every operator
-        # receives dt as the same device scalar, so the steady-state loop
-        # is provably transfer-clean under jax.transfer_guard("disallow")
-        # (analysis/runtime.py; the sanitizer contract in VALIDATION.md)
-        dt_dev = device_scalar(dt, s.dtype, tag="dt-upload")
-        for op in self.pipeline:
-            with s.profiler(op.name):
-                op(dt_dev)
-        if s.pending_parts:
-            with s.profiler("SyncQoI"):
-                entry = self._emit_step_pack()
-                if self.cfg.pipelined:
-                    # grouped deferred read (sim/pack.py): the transfer of
-                    # K packs overlaps later steps' device work; mirrors
-                    # are applied strictly FIFO on the main thread
-                    self._pack_reader.emit(entry)
-                else:
-                    self._consume_pack(entry)
-        elif self._pack_reader:
-            # a pack-less step (ADVICE r2: unreachable today in pipelined
-            # mode, but the coupling is fragile): keep draining so queued
-            # reads and the stale-umax chain still make progress
-            self._pack_reader.flush()
-        s.step += 1
-        s.time += dt
+        # step span + flight ring: wall/sections/solver-iters land in the
+        # trace record (CUP3D_TRACE=1) and the postmortem ring (always)
+        with self._obs.step(s.step, s.time, dt, umax=self._last_umax):
+            self._maybe_dump_save()
+            # ONE sanctioned host->device upload per step: every operator
+            # receives dt as the same device scalar, so the steady-state
+            # loop is provably transfer-clean under
+            # jax.transfer_guard("disallow") (analysis/runtime.py; the
+            # sanitizer contract in VALIDATION.md)
+            dt_dev = device_scalar(dt, s.dtype, tag="dt-upload")
+            for op in self.pipeline:
+                with s.profiler(op.name):
+                    op(dt_dev)
+            if s.pending_parts:
+                with s.profiler("SyncQoI"):
+                    entry = self._emit_step_pack()
+                    if self.cfg.pipelined:
+                        # grouped deferred read (sim/pack.py): the
+                        # transfer of K packs overlaps later steps' device
+                        # work; mirrors are applied strictly FIFO on the
+                        # main thread
+                        self._pack_reader.emit(entry)
+                    else:
+                        self._consume_pack(entry)
+            elif self._pack_reader:
+                # a pack-less step (ADVICE r2: unreachable today in
+                # pipelined mode, but the coupling is fragile): keep
+                # draining so queued reads and the stale-umax chain still
+                # make progress
+                self._pack_reader.flush()
+            s.step += 1
+            s.time += dt
 
     def _emit_step_pack(self) -> dict:
         """Concatenate every device QoI the step produced (rigid state,
@@ -280,7 +338,8 @@ class Simulation:
         # pack in the solver dtype (a forced f32 cast would silently
         # truncate the rigid trajectory in a float64 configuration); the
         # stream applies its slimming policy before the device concat
-        return self._pack_reader.pack_parts(parts, s.dtype, time=s.time)
+        return self._pack_reader.pack_parts(parts, s.dtype, time=s.time,
+                                            step=s.step)
 
     def _consume_pack(self, entry: dict) -> None:
         """Read one emitted pack (or reuse the worker's fetch) and refresh
@@ -312,6 +371,15 @@ class Simulation:
                 log_forces(s.logger, 0, entry["time"], ob)
             elif name == "umax":
                 self._umax_next = float(seg[0])
+            elif name == "psolve":
+                # [residual, iterations] from PressureProjection — the
+                # consumed values feed the obs gauges, the step trace,
+                # and the flight recorder's residual history (itercap
+                # trips a postmortem there)
+                self._obs.note_solver(
+                    int(entry.get("step", s.step)), seg[1], seg[0],
+                    cap=getattr(s.poisson_solver, "maxiter", None),
+                )
 
     def flush_packs(self) -> None:
         """Drain pending QoI packs so host mirrors are current — called

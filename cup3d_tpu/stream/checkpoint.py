@@ -49,10 +49,26 @@ class AsyncCheckpointer:
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pending: List = []
         self.stats = {"saves": 0, "snapshot_s": 0.0, "write_s": 0.0}
+        # per-instance stats surfaced process-wide through the obs
+        # registry (weakref collector, like stream/qoi.py)
+        import weakref
+
+        from cup3d_tpu.obs import metrics as obs_metrics
+
+        def _collect(ref=weakref.ref(self)):
+            c = ref()
+            if c is None:
+                return {}
+            return {f"checkpoint.{k}": v for k, v in c.stats.items()}
+
+        obs_metrics.register_collector(_collect, owner=self)
 
     def save(self, driver, path: Optional[str] = None) -> str:
         """Snapshot ``driver`` now; write in the background.  Returns the
         checkpoint path (the file lands when the write job completes)."""
+        # jax-lint: allow(JX008, snapshot_s is the checkpointer's native
+        # counter, surfaced through the obs collector in __init__; the
+        # drivers wrap save() in their Checkpoint profiler span)
         t0 = time.perf_counter()
         payload = build_payload(driver)
         # deep-freeze host-mutable obstacle state (device arrays and the
@@ -95,6 +111,9 @@ class AsyncCheckpointer:
         return path
 
     def _write(self, payload: dict, path: str) -> str:
+        # jax-lint: allow(JX008, write_s runs on the background writer
+        # thread — obs spans are main-thread; the counter reaches the
+        # registry via the __init__ collector)
         t0 = time.perf_counter()
         out = write_payload(materialize_payload(payload), path)
         # jax-lint: allow(JX006, materialize_payload host-reads every

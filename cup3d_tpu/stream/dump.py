@@ -195,9 +195,25 @@ class AsyncDumper:
         self._pending: List = []
         self.stats = {"dumps": 0, "bytes_written": 0, "write_s": 0.0,
                       "submit_s": 0.0}
+        # per-instance stats surfaced process-wide through the obs
+        # registry (weakref collector; equal keys from live dumpers sum)
+        import weakref
+
+        from cup3d_tpu.obs import metrics as obs_metrics
+
+        def _collect(ref=weakref.ref(self)):
+            d = ref()
+            if d is None:
+                return {}
+            return {f"dump.{k}": v for k, v in d.stats.items()}
+
+        obs_metrics.register_collector(_collect, owner=self)
 
     def submit(self, prefix: str, time_: float, grid,
                fields: Dict[str, "object"]) -> None:
+        # jax-lint: allow(JX008, submit_s is the dumper's native counter,
+        # surfaced process-wide through the obs collector in __init__;
+        # drivers additionally wrap submit in their Dump profiler span)
         t0 = time.perf_counter()
         staged = {}
         for name, arr in fields.items():
@@ -222,6 +238,9 @@ class AsyncDumper:
         self.stats["submit_s"] += time.perf_counter() - t0
 
     def _write(self, prefix, time_, grid, staged):
+        # jax-lint: allow(JX008, write_s runs on the background writer
+        # thread — obs spans are main-thread (SpanTimer stack); the
+        # counter reaches the registry via the __init__ collector)
         t0 = time.perf_counter()
         host = {k: np.asarray(v) for k, v in staged.items()}
         out = dump_fields_sharded(prefix, time_, grid, host,

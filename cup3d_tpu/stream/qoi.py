@@ -47,6 +47,7 @@ Round-6 additions (the ``stream/`` subsystem, ISSUE 1):
 from __future__ import annotations
 
 import time
+import weakref
 from contextlib import nullcontext
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
@@ -115,6 +116,23 @@ class QoIStream:
         self.queue: List[dict] = []
         self._inflight: List[dict] = []  # {batch, group} FIFO
         self.stats = self._zero_stats()
+        # the per-instance stats dict stays the single store (tests pin
+        # its exact per-stream counts); the process-global registry sees
+        # it through a weakref collector, so `obs.metrics.snapshot()`
+        # carries every live stream's counters under stream.*{stream=name}
+        # and equal-named streams SUM (obs/metrics.py)
+        from cup3d_tpu.obs import metrics as obs_metrics
+
+        def _collect(ref=weakref.ref(self)):
+            st = ref()
+            if st is None:
+                return {}
+            return {
+                f"stream.{k}{{stream={st.name}}}": v
+                for k, v in st.snapshot().items()
+            }
+
+        obs_metrics.register_collector(_collect, owner=self)
 
     @staticmethod
     def _zero_stats() -> dict:
@@ -244,6 +262,10 @@ class QoIStream:
         # jax-lint: allow(JX006, the pre-window calls are host
         # bookkeeping (FIFO pop + readiness poll); the timed np.asarray
         # read IS the sync, and stall_s/read_s split on was_ready)
+        # jax-lint: allow(JX008, the stall_s/read_s split is the stream's
+        # native counter — it feeds the obs registry via the collector
+        # registered in __init__; an obs span here would re-enter the
+        # profiler the stream already reports StreamWait through)
         t0 = time.perf_counter()
         vals = np.asarray(holder["batch"], np.float64)
         elapsed = time.perf_counter() - t0

@@ -42,16 +42,19 @@ def _ke(s):
     return float(jnp.mean(jnp.sum(s.sim.vel * s.sim.vel, axis=-1)))
 
 
-def test_runaway_velocity_aborts():
-    import jax.numpy as jnp
+def test_runaway_velocity_aborts(tmp_path):
+    import os
 
     cfg = SimulationConfig(bpdx=1, bpdy=1, bpdz=1, levelMax=1, levelStart=1,
-                           uMax_allowed=0.5, rampup=0, verbose=False)
+                           uMax_allowed=0.5, rampup=0, verbose=False,
+                           path4serialization=str(tmp_path))
     s = Simulation(cfg)
     s.init()
     s.sim.state["vel"] = s.sim.state["vel"] + 1.0
     with pytest.raises(RuntimeError, match="runaway"):
         s.calc_max_timestep()
+    # round 9: the abort leaves a flight-recorder postmortem (obs/flight)
+    assert any(f.startswith("flight_runaway") for f in os.listdir(tmp_path))
 
 
 def test_dt_policy_ramp():

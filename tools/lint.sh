@@ -1,9 +1,11 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX007
-# incl. the JX007 jit-in-regrid-loop rule) + bytecode compile of the
-# whole package.  Nonzero exit on any non-baselined lint finding or any
-# syntax error.  The shipped tree carries an EMPTY baseline: every
-# finding is inline-annotated with a reason.  Run from the repo root:
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX008
+# incl. the JX007 jit-in-regrid-loop and JX008 timing-outside-obs rules)
+# + the obs trace schema selftest (tools/trace_check.py) + bytecode
+# compile of the whole package.  Nonzero exit on any non-baselined lint
+# finding or any syntax error.  The shipped tree carries an EMPTY
+# baseline: every finding is inline-annotated with a reason.  Run from
+# the repo root:
 #
 #   tools/lint.sh            # lint the package + bench.py
 #   tools/lint.sh mypath/    # lint specific paths instead
@@ -23,6 +25,11 @@ python -m cup3d_tpu.analysis $PATHS -q
 # identifiable at a glance in CI logs (ISSUE 3 satellite)
 echo "== python -m cup3d_tpu.analysis --rules JX007 $PATHS"
 python -m cup3d_tpu.analysis --rules JX007 $PATHS -q
+
+# obs trace schema: producer -> validator round trip without a sim
+# (ISSUE 4 satellite; validates real traces with an argument instead)
+echo "== python tools/trace_check.py --selftest"
+python tools/trace_check.py --selftest
 
 echo "== python -m compileall"
 python -m compileall -q cup3d_tpu/ tests/ bench.py

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Validate a cup3d_tpu JSONL step trace and round-trip its Perfetto
+export (ISSUE 4 satellite).
+
+Usage::
+
+    python tools/trace_check.py run/trace.jsonl            # validate
+    python tools/trace_check.py run/trace.jsonl --perfetto out.json
+    python tools/trace_check.py --selftest                 # CI mode
+
+Checks, per ``cup3d_tpu.obs.trace`` schema version %d:
+
+- every line parses as JSON and passes ``validate_step_record``
+  (required keys, types, schema version, non-negative steps);
+- step indices are non-decreasing;
+- the Chrome trace-event export built from the records (plus, when a
+  ``trace.pfto.json`` sits next to the input, that file itself) parses
+  back and every event carries name/ph/ts, with step spans exposing
+  their record in ``args`` — the properties Perfetto needs to load it.
+
+``--selftest`` (what ``tools/lint.sh`` runs, no simulation needed)
+drives a private TraceSink through spans + step records in a temp dir,
+then validates the files it produced — the full producer->validator
+round trip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cup3d_tpu.obs import trace as obs_trace  # noqa: E402
+
+__doc__ = __doc__ % obs_trace.SCHEMA_VERSION
+
+
+def validate_jsonl(path: str) -> list:
+    """Parse + schema-check every record; returns them (raises on the
+    first problem, naming the line)."""
+    records = []
+    last_step = -1
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{i}: not JSON: {e}")
+            problems = obs_trace.validate_step_record(rec)
+            if problems:
+                raise SystemExit(
+                    f"{path}:{i}: schema violation(s): {problems}"
+                )
+            if rec["step"] < last_step:
+                raise SystemExit(
+                    f"{path}:{i}: step {rec['step']} after {last_step} "
+                    "(records must be non-decreasing in step)"
+                )
+            last_step = rec["step"]
+            records.append(rec)
+    if not records:
+        raise SystemExit(f"{path}: empty trace")
+    return records
+
+
+def _check_chrome(obj: dict, origin: str, want_steps: int) -> None:
+    events = obj.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        raise SystemExit(f"{origin}: no traceEvents")
+    step_spans = 0
+    for e in events:
+        for k in ("name", "ph", "ts"):
+            if k not in e:
+                raise SystemExit(f"{origin}: event missing {k!r}: {e}")
+        if e["name"] == "step":
+            step_spans += 1
+            args = e.get("args", {})
+            if "step" not in args or "dt" not in args:
+                raise SystemExit(
+                    f"{origin}: step span without record args: {e}"
+                )
+    if step_spans < want_steps:
+        raise SystemExit(
+            f"{origin}: {step_spans} step spans < {want_steps} records"
+        )
+
+
+def roundtrip_chrome(records: list, jsonl_path: str) -> None:
+    """Build a Chrome export from the records, serialize, re-parse,
+    check; then check the sibling trace.pfto.json when present."""
+    sink = obs_trace.TraceSink(enabled=True,
+                               directory=tempfile.mkdtemp())
+    t = 0.0
+    for rec in records:
+        sink.events.append({
+            "name": "step", "ph": "X", "pid": 1, "tid": 0,
+            "ts": t * 1e6, "dur": rec["wall_s"] * 1e6, "args": rec,
+        })
+        t += rec["wall_s"]
+        sink.steps_recorded += 1
+    blob = json.dumps(sink.chrome_trace())
+    _check_chrome(json.loads(blob), "<rebuilt export>", len(records))
+    sibling = os.path.join(os.path.dirname(jsonl_path) or ".",
+                           "trace.pfto.json")
+    if os.path.exists(sibling):
+        with open(sibling) as f:
+            _check_chrome(json.load(f), sibling, 1)
+
+
+def selftest() -> None:
+    """Producer->validator round trip on a synthetic trace."""
+    with tempfile.TemporaryDirectory() as td:
+        sink = obs_trace.TraceSink(enabled=True, directory=td,
+                                   max_steps=100)
+        timer = obs_trace.SpanTimer(sink=sink)
+        obsr = obs_trace.StepObserver(timer, kind="selftest")
+        for i in range(5):
+            with obsr.step(i, i * 0.1, 0.1, nb=8):
+                with timer("AdvectionDiffusion"):
+                    with timer("Halo"):
+                        pass
+            obsr.note_solver(i, iters=12 + i, resid=1e-5)
+        # bounded-file contract: max_steps drops, never grows the file
+        sink.max_steps = 3
+        with obsr.step(99, 9.9, 0.1):
+            pass
+        sink.close()
+        records = validate_jsonl(os.path.join(td, "trace.jsonl"))
+        assert len(records) == 5, f"expected 5 records, got {len(records)}"
+        assert sink.steps_dropped == 1, "max_steps drop not counted"
+        # stats are noted when the async pack lands, so record i carries
+        # the stats consumed BEFORE it closed (here: step i-1's solve)
+        solver = records[-1]["solver"]
+        assert solver["iters"] == 15.0 and solver["at_step"] == 3, solver
+        roundtrip_chrome(records, os.path.join(td, "trace.jsonl"))
+    print("trace_check selftest: OK")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate a cup3d_tpu JSONL step trace "
+                    f"(schema v{obs_trace.SCHEMA_VERSION})")
+    ap.add_argument("trace", nargs="?", help="trace.jsonl to validate")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="also write a fresh Chrome export here")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthesize + validate a trace (CI, no sim)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        selftest()
+        return 0
+    if not args.trace:
+        ap.error("give a trace.jsonl or --selftest")
+    records = validate_jsonl(args.trace)
+    roundtrip_chrome(records, args.trace)
+    if args.perfetto:
+        sink = obs_trace.TraceSink(enabled=True,
+                                   directory=os.path.dirname(args.perfetto)
+                                   or ".")
+        t = 0.0
+        for rec in records:
+            sink.events.append({
+                "name": "step", "ph": "X", "pid": 1, "tid": 0,
+                "ts": t * 1e6, "dur": rec["wall_s"] * 1e6, "args": rec,
+            })
+            t += rec["wall_s"]
+        sink.export_chrome(args.perfetto)
+    with_solver = sum(1 for r in records if "solver" in r)
+    print(f"trace_check: OK — {len(records)} records "
+          f"(steps {records[0]['step']}..{records[-1]['step']}, "
+          f"{with_solver} with solver stats)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
