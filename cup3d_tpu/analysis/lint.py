@@ -37,6 +37,11 @@ Rule summary (full rationale in ``analysis/rules.py``):
          package but outside ``cup3d_tpu/obs/``: use obs spans, so the
          measured wall reaches the registry/trace/flight recorder
          instead of a private counter.
+- JX009  swallowed exception inside the package (handler body is only
+         ``pass``/``continue``/``break``/a bare log call): the failure
+         leaves no counter, no state, no re-raise.  ``cup3d_tpu/
+         resilience/`` is exempt by path — containing already-counted
+         failures is its job.
 """
 
 from __future__ import annotations
@@ -343,7 +348,9 @@ class FileLint:
                 )
             self._check_timing_windows(func, qualname)      # JX006
             self._check_manual_timing(func, qualname)       # JX008
+            self._check_swallowed_exceptions(func, qualname)  # JX009
         self._check_dtype_literals()                        # JX005
+        self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         return self.violations
 
     # -- plumbing ----------------------------------------------------------
@@ -723,6 +730,55 @@ class FileLint:
                 "obs metrics so the measurement reaches the registry "
                 "and the step trace",
             )
+
+    # -- JX009 -------------------------------------------------------------
+
+    #: attribute names of log-like drop calls (log-and-drop handlers)
+    _LOG_ATTRS = frozenset(
+        {"warn", "warning", "error", "info", "debug", "exception"}
+    )
+
+    def _is_droppy_stmt(self, stmt: ast.stmt) -> bool:
+        """A handler statement that drops the failure on the floor:
+        pass/continue/break, a bare constant (docstring), or a pure
+        log/print call.  Anything else — assignment, raise, return with
+        a value, a counter ``.inc()`` — makes the handler observable."""
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            return True
+        if isinstance(stmt, ast.Expr):
+            v = stmt.value
+            if isinstance(v, ast.Constant):
+                return True
+            if isinstance(v, ast.Call):
+                name = _call_name(v)
+                if name == "print" or name.endswith("warnings.warn"):
+                    return True
+                if (isinstance(v.func, ast.Attribute)
+                        and v.func.attr in self._LOG_ATTRS):
+                    return True
+        return False
+
+    def _check_swallowed_exceptions(self, func: ast.AST,
+                                    qualname: str) -> None:
+        """``except`` handlers whose whole body drops the failure (JX009).
+        Package scope only; ``cup3d_tpu/resilience/`` is exempt — its
+        handlers ARE the degradation policy and carry their own
+        counters."""
+        if not self.path.startswith("cup3d_tpu/"):
+            return
+        if self.path.startswith("cup3d_tpu/resilience/"):
+            return
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.body and all(self._is_droppy_stmt(s)
+                                 for s in node.body):
+                self._emit(
+                    "JX009", node, qualname,
+                    "exception swallowed (pass/log-and-drop): re-raise, "
+                    "latch it into state, or bump an obs counter so the "
+                    "failure is observable",
+                )
 
 
 # -- baseline ---------------------------------------------------------------

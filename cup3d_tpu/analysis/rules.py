@@ -102,6 +102,20 @@ RULES: Dict[str, Rule] = {
             "stall/read splits, which ARE the registry's data source.",
         ),
         Rule(
+            "JX009",
+            "swallowed exception (drop without counter or re-raise)",
+            "An `except: pass`/`continue` (or a log-and-drop handler) "
+            "erases the only evidence of a failure: the round-10 "
+            "resilience work found background checkpoint-write errors "
+            "that vanished this way until the run ended with silent data "
+            "loss.  A handler must re-raise, return a sentinel the "
+            "caller checks, record the error into state, or at minimum "
+            "bump an obs-registry counter so the drop is observable; "
+            "deliberate capability probes are annotated inline.  The "
+            "resilience/ subsystem (whose whole job is containing "
+            "failures it has already counted) is exempt by path.",
+        ),
+        Rule(
             "JX005",
             "float64 dtype literal in device code",
             "A bare float64 dtype in device code either doubles bandwidth "

@@ -86,10 +86,22 @@ def materialize_payload(payload: dict) -> dict:
 
 
 def write_payload(payload: dict, path: str) -> str:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-    return path
+    """Atomic, retried checkpoint write (round 10): the payload pickles
+    into ``<path>.tmp`` and is promoted with ``os.replace``, so a kill
+    (or an armed ``ckpt.write_fail`` injection) at any instant leaves
+    either the previous complete file or none — never a truncated
+    pickle.  Transient failures retry with backoff + jitter
+    (resilience/writeguard.py)."""
+    from cup3d_tpu.resilience import faults, writeguard
+
+    def _write(tmp: str) -> None:
+        # injection seam: fires on EVERY retry while armed, so a
+        # persistent-failure scenario is one multi-count arm
+        faults.maybe_raise("ckpt.write_fail", payload.get("step"))
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    return writeguard.atomic_write(path, _write, site="ckpt")
 
 
 def save_checkpoint(driver, path: Optional[str] = None) -> str:
@@ -103,19 +115,82 @@ def save_checkpoint(driver, path: Optional[str] = None) -> str:
     return write_payload(materialize_payload(payload), path)
 
 
+def read_payload(path: str) -> dict:
+    """Unpickle + validate one checkpoint payload.  A partial/corrupt
+    file (killed writer predating the round-10 atomic writes, disk
+    damage, or just not-a-checkpoint) raises ``ValueError`` with a clear
+    message instead of an unpickling traceback."""
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+    except OSError:
+        raise  # missing/unreadable file: the caller's error is clearer
+    except Exception as e:
+        raise ValueError(
+            f"corrupt or truncated checkpoint {path!r}: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    if not isinstance(payload, dict) or "version" not in payload:
+        raise ValueError(
+            f"not a cup3d_tpu checkpoint payload: {path!r}"
+        )
+    if payload["version"] != FORMAT_VERSION:
+        raise ValueError(f"unknown checkpoint version {payload['version']}")
+    missing = [k for k in ("kind", "cfg", "fields", "time", "step", "dt")
+               if k not in payload]
+    if missing:
+        raise ValueError(
+            f"incomplete checkpoint {path!r}: missing keys {missing}"
+        )
+    return payload
+
+
+def list_checkpoints(directory: str):
+    """``ckpt_*.pkl`` files under ``directory``, oldest step first."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        if n.startswith("ckpt_") and n.endswith(".pkl"):
+            try:
+                step = int(n[len("ckpt_"):-len(".pkl")])
+            # jax-lint: allow(JX009, a non-checkpoint filename that
+            # merely matches the prefix is skipped by design)
+            except ValueError:
+                continue
+            out.append((step, os.path.join(directory, n)))
+    return [p for _, p in sorted(out)]
+
+
+def latest_valid_checkpoint(directory: str) -> Optional[str]:
+    """Newest checkpoint under ``directory`` whose payload validates —
+    the crash-restart entry point: a run killed mid-save restarts from
+    the last COMPLETE file, skipping anything partial or corrupt."""
+    for path in reversed(list_checkpoints(directory)):
+        try:
+            read_payload(path)
+        # jax-lint: allow(JX009, skipping invalid candidates IS this
+        # function's contract: the caller restarts from the newest
+        # checkpoint that validates)
+        except (ValueError, OSError):
+            continue
+        return path
+    return None
+
+
 def load_checkpoint(path: str, mesh=None):
     """Rebuild the driver (AMRSimulation or Simulation) from a checkpoint,
     ready to continue stepping.  ``mesh`` (a 1-D jax Mesh) restores an AMR
     checkpoint INTO sharded (mesh) mode: fields are padded + sharded over
     the device mesh exactly as a fresh mesh-mode run lays them out —
     checkpoints themselves are layout-free (unpadded numpy), so saves from
-    single-device runs restore sharded and vice versa."""
+    single-device runs restore sharded and vice versa.  Partial/corrupt
+    files raise ``ValueError`` (see :func:`read_payload`)."""
     from cup3d_tpu.config import SimulationConfig
 
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    if payload["version"] != FORMAT_VERSION:
-        raise ValueError(f"unknown checkpoint version {payload['version']}")
+    payload = read_payload(path)
     cfg = SimulationConfig(**payload["cfg"])
 
     if payload["kind"] == "amr":

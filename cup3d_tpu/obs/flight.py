@@ -72,6 +72,8 @@ def _jsonable(obj, depth: int = 0):
         if hasattr(obj, attr) and not hasattr(obj, "__len__"):
             try:
                 return _jsonable(obj.item(), depth + 1)
+            # jax-lint: allow(JX009, best-effort JSON coercion: the
+            # fallthrough to repr(obj) below IS the handling)
             except Exception:
                 break
     if hasattr(obj, "__dict__") and not callable(obj):
@@ -79,6 +81,8 @@ def _jsonable(obj, depth: int = 0):
             return {k: _jsonable(v, depth + 1)
                     for k, v in vars(obj).items()
                     if not k.startswith("_")}
+        # jax-lint: allow(JX009, best-effort JSON coercion: the
+        # fallthrough to repr(obj) below IS the handling)
         except Exception:
             pass
     return repr(obj)
@@ -107,6 +111,19 @@ class FlightRecorder:
         self.last_known_good_step: Optional[int] = None
         self.dumps_written: List[str] = []
         self._c_dumps = _metrics.counter("flight.dumps")
+        # round-10 recovery (resilience/recovery.py): when a
+        # RecoveryEngine is installed it claims recoverable triggers via
+        # this hook — the trigger then records a recovery event instead
+        # of a postmortem, and the engine rolls the run back.  The ring
+        # of rollback/retry events rides in any LATER postmortem.
+        self.recovery_intercept: Optional[Callable[[str, dict], bool]] = None
+        self.recovery_events: deque = deque(maxlen=64)
+
+    def note_recovery(self, event: dict) -> None:
+        """Append one rollback/retry/give-up event (engine bookkeeping;
+        O(1) host work — part of every postmortem payload)."""
+        self.recovery_events.append(dict(event))
+        _metrics.counter("flight.recovery_events").inc()
 
     # -- recording (hot path: O(1) host appends) ---------------------------
 
@@ -137,7 +154,20 @@ class FlightRecorder:
     def trigger(self, reason: str, extra: Optional[dict] = None
                 ) -> Optional[str]:
         """Write the postmortem (once per ``max_dumps``); returns the
-        path, or None when the dump budget is spent."""
+        path, or None when the dump budget is spent or an installed
+        recovery engine claims the failure (it records a recovery event
+        and rolls the run back instead — resilience/recovery.py)."""
+        if self.recovery_intercept is not None:
+            try:
+                handled = bool(self.recovery_intercept(reason, extra or {}))
+            except Exception:  # a broken engine must not block the dump
+                handled = False
+            if handled:
+                self.note_recovery({
+                    "reason": reason, "intercepted": True,
+                    "extra": _jsonable(extra or {}),
+                })
+                return None
         if len(self.dumps_written) >= self.max_dumps:
             return None
         at_step = None
@@ -162,6 +192,7 @@ class FlightRecorder:
             "extra": _jsonable(extra or {}),
             "steps": [_jsonable(r) for r in self.steps],
             "residual_history": list(self.residuals),
+            "recovery_events": [_jsonable(e) for e in self.recovery_events],
             "metrics": _jsonable(_metrics.snapshot()),
         }
         os.makedirs(self.directory or ".", exist_ok=True)

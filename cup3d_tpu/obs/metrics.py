@@ -30,6 +30,14 @@ data-plane's ``stats``) register a **collector**: a zero-arg callable
 into every snapshot.  That keeps per-instance semantics where tests
 rely on them while the registry stays the one query surface.
 
+Round 10 added the resilience families (README "Resilience" has the
+full catalog): ``faults.injected{site=…}`` per injected firing;
+``resilience.snapshots`` / ``.rollbacks`` / ``.retries{stage=…}`` /
+``.giveups`` / ``.snapshot_failures`` from the RecoveryEngine;
+``resilience.write_retries{site=…}`` / ``.ckpt_sync_fallbacks`` /
+``.ckpt_dropped`` and ``dump.write_dropped`` from the hardened write
+paths; ``flight.recovery_events`` per recorded rollback event.
+
 This module deliberately imports neither jax nor numpy: it must stay
 importable (and cheap) from anywhere, including the analysis layer.
 """
@@ -212,7 +220,11 @@ class MetricsRegistry:
             try:
                 for k, v in fn().items():
                     out[k] = out.get(k, 0) + v if k in out else v
-            except Exception:  # a dying collector must not kill telemetry
+            # jax-lint: allow(JX009, a dying collector must not kill
+            # telemetry, and counting INTO the registry being
+            # snapshotted here would recurse; dead owners are dropped
+            # by the weakref sweep above)
+            except Exception:
                 continue
         return out
 

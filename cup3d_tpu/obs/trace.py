@@ -453,7 +453,18 @@ class StepObserver:
     def note_solver(self, step: int, iters: float, resid: float,
                     cap: Optional[int] = None) -> None:
         """Record one consumed (iterations, residual) pair; trips the
-        flight recorder when the solve burned its iteration cap."""
+        flight recorder when the solve burned its iteration cap.
+
+        This consumption point is the solver fault-injection seam
+        (resilience/faults.py): the armed sites corrupt the HOST copy of
+        the packed stats, so the whole detection -> trigger -> recovery
+        chain runs exactly as it would on a real solver failure."""
+        from cup3d_tpu.resilience import faults
+
+        if faults.fire("solver.nan_residual", step):
+            resid = float("nan")
+        if cap is not None and faults.fire("solver.itercap", step):
+            iters = float(cap)
         self.last_solver = {"iters": float(iters), "resid": float(resid),
                             "at_step": int(step)}
         self._g_iters.set(float(iters))

@@ -225,9 +225,13 @@ def build_amr_poisson_solver(
     vol: Optional[jnp.ndarray] = None,
     pmask: Optional[jnp.ndarray] = None,
     mean_constraint: int = 2,
+    two_level: Optional[bool] = None,
 ):
     """getZ-preconditioned BiCGSTAB on the AMR forest: the direct TPU
     analogue of PoissonSolverAMR (main.cpp:14363-14616).
+    ``two_level`` overrides the CUP3D_COARSE env default (None =
+    ``krylov.use_coarse_correction``) — the resilience escalation ladder
+    drops to tile-only getZ per driver, not per process.
 
     ``mean_constraint`` mirrors the reference's bMeanConstraint
     (ComputeLHS, main.cpp:9273-9327):
@@ -276,8 +280,10 @@ def build_amr_poisson_solver(
     # their removed nullspace reintroduced by the singular coarse solve
     # (ADVICE r5), and the sharded forest's _PaddedGeom carries no tree
     # (distributed coarse solve is future work — VALIDATION.md).
+    use_two = (krylov.use_coarse_correction() if two_level is None
+               else bool(two_level))
     graph = None
-    if (krylov.use_coarse_correction() and mean_constraint not in (1, 3)
+    if (use_two and mean_constraint not in (1, 3)
             and hasattr(grid, "tree")):
         graph = krylov.block_graph_tables(grid)
 

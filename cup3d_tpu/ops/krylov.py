@@ -764,6 +764,7 @@ def build_iterative_solver(
     precond_bs: int = 8,
     precond_iters: int = 24,
     mean_constraint: int = 2,
+    two_level: Optional[bool] = None,
 ) -> Callable:
     """solve(rhs) -> p via getZ-preconditioned BiCGSTAB.
 
@@ -776,6 +777,11 @@ def build_iterative_solver(
 
     The solve runs in the lane-resident tile layout (to_lanes /
     make_laplacian_lanes): one transpose in, one out, none per iteration.
+
+    ``two_level`` overrides the CUP3D_COARSE env default for the
+    preconditioner choice (None = :func:`use_coarse_correction`): the
+    resilience escalation ladder drops to the tile-only getZ without
+    touching process-global state (resilience/recovery.py).
     """
     if any(s % precond_bs for s in grid.shape):
         return _build_iterative_solver_dense(
@@ -800,7 +806,9 @@ def build_iterative_solver(
     else:
         A = A0
 
-    if use_coarse_correction() and mean_constraint not in (1, 3):
+    use_two = (use_coarse_correction() if two_level is None
+               else bool(two_level))
+    if use_two and mean_constraint not in (1, 3):
         # multiplicative two-level: 12 outer iterations vs 51 tile-only at
         # 128^3, resolution-independent (make_twolevel_preconditioner_lanes)
         M = make_twolevel_preconditioner_lanes(grid, h2, precond_bs,

@@ -94,13 +94,17 @@ def _apply_mat(mat, f, axis):
 def make_poisson_solver(grid: UniformGrid, kind: str = "spectral",
                         dtype=jnp.float32, tol_abs: float = 1e-6,
                         tol_rel: float = 1e-4, maxiter: int = 1000,
-                        mean_constraint: int = 2) -> Callable:
+                        mean_constraint: int = 2,
+                        two_level=None) -> Callable:
     """Factory mirroring the reference's makePoissonSolver
     (main.cpp:14747-14758): "spectral" = exact uniform-grid diagonalization
     (this module); "iterative" = getZ-preconditioned BiCGSTAB
     (cup3d_tpu.ops.krylov), the path that generalizes to AMR.
     ``mean_constraint`` = the reference's bMeanConstraint for the
-    iterative path; the spectral solve is mean-free by construction."""
+    iterative path; the spectral solve is mean-free by construction.
+    ``two_level``/``maxiter`` parameterize the iterative path for the
+    resilience escalation ladder (resilience/recovery.py); the spectral
+    solver is direct and ignores both."""
     if kind == "spectral":
         return build_spectral_solver(grid, dtype)
     if kind == "iterative":
@@ -108,7 +112,7 @@ def make_poisson_solver(grid: UniformGrid, kind: str = "spectral",
 
         return build_iterative_solver(
             grid, tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter,
-            mean_constraint=mean_constraint,
+            mean_constraint=mean_constraint, two_level=two_level,
         )
     raise ValueError(f"unknown poissonSolver {kind!r}")
 

@@ -1,0 +1,25 @@
+"""Self-healing step loop (ISSUE 5): deterministic fault injection at
+named seams (``resilience.faults``), hardened host-data-plane writes
+(``resilience.writeguard``), and the rollback/retry RecoveryEngine both
+drivers run their ``simulate()`` loop through (``resilience.recovery``).
+
+Env knobs (full catalog in README "Resilience"):
+
+- ``CUP3D_RECOVER``     1 (default) arms recovery inside ``simulate()``;
+                        0 keeps the legacy crash-on-fault behavior (the
+                        equivalence baseline).
+- ``CUP3D_FAULT``       ``site@step[:count]`` (``;``-separated) arms
+                        deterministic fault injection, e.g.
+                        ``step.nan_velocity@40:1``.
+- ``CUP3D_SNAP_EVERY``  rolling in-memory snapshot cadence (steps, 16).
+- ``CUP3D_MAX_RETRIES`` rollback attempts before the postmortem +
+                        restartable-checkpoint give-up (4).
+- ``CUP3D_DT_FLOOR``    lower bound for the retry dt halving (1e-9).
+"""
+
+from cup3d_tpu.resilience import faults  # noqa: F401 (public surface)
+from cup3d_tpu.resilience.recovery import (  # noqa: F401
+    RecoveryEngine,
+    SimulationFailure,
+    recovery_enabled,
+)

@@ -1,0 +1,65 @@
+"""Hardened host-side writes for the data-plane (ISSUE 5 tentpole b).
+
+Two invariants for every checkpoint/dump byte that reaches disk:
+
+1. **atomicity** — payloads are written to ``<path>.tmp`` and promoted
+   with ``os.replace``, so a kill (or an injected ``*.write_fail``) at
+   any instant leaves either the previous complete file or none: readers
+   never see a truncated pickle / half a raw extent;
+2. **bounded retries** — transient write failures (full-but-recovering
+   disk, NFS hiccups) are retried with exponential backoff plus jitter
+   before the caller's degradation policy (sync fallback for
+   checkpoints, drop-and-count for dumps) kicks in.
+
+Every retry is counted in the obs registry
+(``resilience.write_retries{site=...}``).  This module deliberately
+knows nothing about payload formats — callers pass a ``write_fn`` that
+produces the complete tmp file.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Callable
+
+from cup3d_tpu.obs import metrics as _metrics
+
+
+def backoff_sleep(attempt: int, base_delay: float = 0.05,
+                  jitter: float = 0.5) -> None:
+    """Exponential backoff before retry ``attempt`` (1-based) with a
+    multiplicative jitter so concurrent writers decorrelate."""
+    import time
+
+    delay = base_delay * (2 ** (attempt - 1))
+    time.sleep(delay * (1.0 + jitter * random.random()))
+
+
+def atomic_write(path: str, write_fn: Callable[[str], None],
+                 site: str = "write", retries: int = 2,
+                 base_delay: float = 0.05) -> str:
+    """Run ``write_fn(tmp_path)`` (which must produce the COMPLETE file
+    at ``tmp_path``) then ``os.replace`` it over ``path``; on failure the
+    tmp file is removed and the write retried up to ``retries`` times
+    with backoff + jitter.  Raises the last failure; on success returns
+    ``path``."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp"
+    last: Exception = RuntimeError("unreachable")
+    for attempt in range(retries + 1):
+        if attempt:
+            _metrics.counter("resilience.write_retries", site=site).inc()
+            backoff_sleep(attempt, base_delay)
+        try:
+            write_fn(tmp)
+            os.replace(tmp, path)
+            return path
+        except Exception as e:
+            last = e
+            try:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+            except OSError:
+                _metrics.counter("resilience.tmp_unlink_failures").inc()
+    raise last

@@ -64,6 +64,8 @@ from cup3d_tpu.ops.penalization import (
     penalize,
     per_obstacle_penalization_force,
 )
+from cup3d_tpu.resilience import faults
+from cup3d_tpu.resilience.recovery import SimulationFailure
 
 ADAPT_EVERY = 20  # reference cadence (main.cpp:15314)
 _EPS = 1e-6
@@ -234,6 +236,12 @@ class AMRSimulation:
         self._table_memo: Dict = {}   # octree signature -> padded bundle
         self._exec_cache: Dict = {}   # bucket key -> jitted executables
         self._solver_core = None
+        # round-10 resilience: simulate() installs a RecoveryEngine here
+        # (CUP3D_RECOVER=1, the default); the Poisson escalation ladder
+        # overrides these per driver (resilience/recovery.py)
+        self._resilience = None
+        self._poisson_two_level = None  # None = CUP3D_COARSE default
+        self._poisson_maxiter = 1000
         self._rebuild()
         self._alloc_fields()
 
@@ -255,6 +263,10 @@ class AMRSimulation:
             "collision_hot": bool(self._collision_hot),
             "obstacles": [type(ob).__name__ for ob in self.obstacles],
             "stream": self._pack_reader.snapshot(),
+            # round 10: the async writers' health rides in postmortems
+            # (latched background failures, drop counts)
+            "checkpointer": self._checkpointer.health(),
+            "dumper": self._dumper.health(),
         }
 
     # the obstacle classes address their host as `sim`; provide the same
@@ -349,8 +361,10 @@ class AMRSimulation:
             self._ftab = build_flux_tables(g)
             self._solver = amr_ops.build_amr_poisson_solver(
                 g, tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel,
+                maxiter=self._poisson_maxiter,
                 tab=self._tab1, flux_tab=self._ftab,
                 mean_constraint=cfg.bMeanConstraint,
+                two_level=self._poisson_two_level,
             )
             self._h_col = jnp.asarray(
                 g.h.reshape(g.nb, 1, 1, 1), self.dtype
@@ -564,7 +578,9 @@ class AMRSimulation:
         if memo is None:
             cap = bk.capacity(g.nb)
             coarse = (krylov.use_coarse_correction()
-                      and cfg.bMeanConstraint not in (1, 3))
+                      if self._poisson_two_level is None
+                      else bool(self._poisson_two_level))
+            coarse = coarse and cfg.bMeanConstraint not in (1, 3)
             h = np.ones(cap, np.float64)
             h[: g.nb] = g.h
             vol = np.zeros((cap, 1, 1, 1), np.float64)
@@ -615,6 +631,7 @@ class AMRSimulation:
         if self._solver_core is None:
             self._solver_core = amr_ops.build_amr_poisson_solver_dynamic(
                 g.bs, tol_abs=cfg.poissonTol, tol_rel=cfg.poissonTolRel,
+                maxiter=self._poisson_maxiter,
                 mean_constraint=cfg.bMeanConstraint,
             )
 
@@ -1545,10 +1562,22 @@ class AMRSimulation:
         freshest host MIRROR (stale by <= ~3*read_every steps — an abort
         tolerates lag; the dt itself never does)."""
         cfg = self.cfg
+        if faults.fire("step.nan_velocity", self.step_idx):
+            # injected fault: poison the host mirror so the existing
+            # runaway/NaN abort below detects it (resilience/faults.py)
+            self._umax_next = float("nan")
         um = self._umax_next
         if um is not None and (not np.isfinite(um) or um > cfg.uMax_allowed):
             self.logger.flush()
-            raise RuntimeError(f"runaway velocity: max|u|={um:.3g}")
+            reason = ("nan-velocity" if not np.isfinite(um)
+                      else "runaway-velocity")
+            extra = {"step": self.step_idx, "umax": um}
+            # postmortem (or recovery interception) BEFORE the raise,
+            # like the host-dt path below
+            self.flight.trigger(reason, extra=extra)
+            raise SimulationFailure(
+                reason, f"runaway velocity: max|u|={um:.3g}", extra
+            )
         if self._umax_dev is None:
             self._umax_dev = self._maxu(self.state["vel"], self.uinf_device())
         from cup3d_tpu.sim import dtpolicy
@@ -1569,6 +1598,10 @@ class AMRSimulation:
                     jnp.asarray(hmin, self.dtype),
                     jnp.asarray(self.nu, self.dtype),
                 )
+        if self._resilience is not None:
+            # retry dt halving: identity at scale 1.0, one eager device
+            # multiply while recovering (no host sync either way)
+            dt = self._resilience.scale_dt(dt)
         self.dt = dt
         if cfg.DLM > 0:
             self.lambda_penal = cfg.DLM / dt
@@ -1579,6 +1612,10 @@ class AMRSimulation:
         if self._use_device_dt():
             return self._calc_dt_device()
         hmin = float(self.grid.h.min())
+        if faults.fire("step.nan_velocity", self.step_idx):
+            # injected fault: poison the max|u| mirror so the EXISTING
+            # NaN-umax abort below detects it (resilience/faults.py)
+            self._umax_next = float("nan")
         if self._umax_next is not None:
             umax = self._umax_next
             if not cfg.pipelined:
@@ -1613,12 +1650,13 @@ class AMRSimulation:
             self.logger.flush()
             # postmortem BEFORE the raise (obs/flight.py): ring, residual
             # history, bucket/capacity state, last-known-good step
-            self.flight.trigger(
-                "nan-velocity" if not np.isfinite(umax)
-                else "runaway-velocity",
-                extra={"step": self.step_idx, "umax": umax},
+            reason = ("nan-velocity" if not np.isfinite(umax)
+                      else "runaway-velocity")
+            extra = {"step": self.step_idx, "umax": umax}
+            self.flight.trigger(reason, extra=extra)
+            raise SimulationFailure(
+                reason, f"runaway velocity: max|u|={umax:.3g}", extra
             )
-            raise RuntimeError(f"runaway velocity: max|u|={umax:.3g}")
         if cfg.dt > 0:
             self.dt = cfg.dt
         else:
@@ -1637,14 +1675,21 @@ class AMRSimulation:
                 self.dt = min(self.dt, 1.03 * prev_dt)
             if cfg.tend > 0:
                 self.dt = min(self.dt, cfg.tend - self.time)
+        if self._resilience is not None:
+            # retry dt halving (exact no-op at scale 1.0, so the armed
+            # clean path stays bitwise-identical to CUP3D_RECOVER=0)
+            self.dt = self._resilience.scale_dt(self.dt)
+        if faults.fire("dt.collapse", self.step_idx):
+            # injected fault: collapse dt so the existing abort trips
+            self.dt = float("nan")
         if not np.isfinite(self.dt) or self.dt <= 0:
             # dt policy collapse -> postmortem + abort (obs/flight.py)
-            self.flight.trigger(
-                "dt-collapse",
-                extra={"step": self.step_idx, "dt": self.dt,
-                       "umax": umax},
+            extra = {"step": self.step_idx, "dt": self.dt, "umax": umax}
+            self.flight.trigger("dt-collapse", extra=extra)
+            raise SimulationFailure(
+                "dt-collapse", f"dt policy collapse: dt={self.dt:.3g}",
+                extra,
             )
-            raise RuntimeError(f"dt policy collapse: dt={self.dt:.3g}")
         if cfg.DLM > 0:
             self.lambda_penal = cfg.DLM / self.dt
         return self.dt
@@ -1660,7 +1705,27 @@ class AMRSimulation:
             with self.profiler("Checkpoint"):
                 # async snapshot: fields stage via copy_to_host_async and
                 # serialize on the writer thread (stream/checkpoint.py)
-                self._checkpointer.save(self)
+                self._save_checkpoint_guarded()
+
+    def _save_checkpoint_guarded(self):
+        """Round-10 degradation policy (see sim/simulation.py): under
+        recovery a surfaced background-write failure falls back to one
+        synchronous atomic write, then drops + counts — output must
+        never kill the step loop.  Legacy behavior without recovery."""
+        from cup3d_tpu.obs import metrics as obs_metrics
+
+        try:
+            self._checkpointer.save(self)
+        except Exception:
+            if self._resilience is None:
+                raise
+            obs_metrics.counter("resilience.ckpt_sync_fallbacks").inc()
+            try:
+                from cup3d_tpu.io.checkpoint import save_checkpoint
+
+                save_checkpoint(self)
+            except Exception:
+                obs_metrics.counter("resilience.ckpt_dropped").inc()
 
     def dump_fields(self):
         import os
@@ -1681,7 +1746,8 @@ class AMRSimulation:
                 # the step loop (stream/dump.py).  The grid object handed
                 # over is this step's layout — adaptation replaces, never
                 # mutates, the BlockGrid, so the snapshot stays coherent.
-                self._dumper.submit(prefix, self.time, self.grid, fields)
+                self._dumper.submit(prefix, self.time, self.grid, fields,
+                                    step=self.step_idx)
 
     def drain_streams(self):
         """Join all off-critical-path output (pending dumps/checkpoints,
@@ -1690,7 +1756,16 @@ class AMRSimulation:
         from cup3d_tpu.obs import trace as obs_trace
 
         self._dumper.wait()
-        self._checkpointer.wait()
+        try:
+            self._checkpointer.wait()
+        except Exception:
+            # under recovery a failed final checkpoint write must not
+            # fail an otherwise-complete run: drop + count
+            if self._resilience is None:
+                raise
+            from cup3d_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.counter("resilience.ckpt_dropped").inc()
         obs_trace.TRACE.flush()
 
     def _log_diagnostics(self):
@@ -2190,20 +2265,109 @@ class AMRSimulation:
         # joins the end-of-step packed read (_consume_step_pack)
         self._pending_parts.append(("forces", jnp.stack(rows).reshape(-1)))
 
-    def simulate(self):
+    # -- resilience hooks (resilience/recovery.py driver contract) ---------
+
+    def _resilience_restore(self, payload: dict):
+        """In-place rollback to a ``build_payload``-shaped in-memory
+        snapshot: rebuild the octree/grid from the snapshot's leaf keys
+        (exactly ``io.checkpoint.load_checkpoint``'s AMR branch, minus
+        the disk), rebind the compiled executables — a topology already
+        seen hits the table memo and the bucketed exec cache, so the
+        common rollback costs zero retraces — and restore fields/host
+        scalars/obstacles."""
+        import pickle
+
+        from cup3d_tpu.grid.octree import Octree, TreeConfig
+
         cfg = self.cfg
-        while True:
-            dt = self.calc_max_timestep()
-            if cfg.verbose:
-                print(
-                    f"cup3d_tpu[amr]: step: {self.step_idx}, time: {self.time:f},"
-                    f" dt: {dt:.3e}, blocks: {self.grid.nb}"
-                )
-            self.advance(dt)
-            done_t = cfg.tend > 0 and self.time >= cfg.tend - 1e-12
-            done_n = cfg.nsteps > 0 and self.step_idx >= cfg.nsteps
-            if done_t or done_n:
-                break
-        self.flush_packs()
-        self.drain_streams()
-        self.logger.flush()
+        periodic = tuple(b == "periodic" for b in cfg.bc)
+        tree = Octree(
+            TreeConfig((cfg.bpdx, cfg.bpdy, cfg.bpdz), cfg.levelMax,
+                       periodic),
+            0,
+        )
+        tree.leaves.clear()
+        for l, i, j, k in payload["leaves"]:
+            tree.leaves[(int(l), int(i), int(j), int(k))] = None
+        tree.assert_balanced()
+        self.grid = BlockGrid(
+            tree, cfg.extents, tuple(BC(b) for b in cfg.bc), cfg.block_size
+        )
+        self._scores_prefetch = None
+        self._rebuild()
+        # re-copy on the way in: the step jits donate these buffers and
+        # the engine's snapshot must survive repeated restores
+        self.state = {
+            k: self._pad(jnp.copy(v)) for k, v in payload["fields"].items()
+        }
+        self.time = float(payload["time"])
+        self.step_idx = int(payload["step"])
+        self.dt = float(payload["dt"])
+        self.uinf = np.asarray(payload["uinf"], np.float64)
+        self.lambda_penal = float(payload["lambda_penal"])
+        self._cadence.next_dump = float(payload["next_dump"])
+        self.obstacles = pickle.loads(payload["obstacles"])
+        for ob in self.obstacles:
+            ob.sim = self
+        self._pending_parts = []
+        self._umax_next = None
+        self._umax_dev = None
+        self._uinf_dev = None
+        self._last_umax = None
+        self._collision_hot = False
+        # mirrors queued from the abandoned trajectory must never apply
+        self._pack_reader.abandon()
+        if self.obstacles:
+            self.create_obstacles(0.0)  # rebuild chi/udef/sdf on device
+
+    def _resilience_zero_pressure(self):
+        """Escalation stage 'zero-guess': the next solve warm-starts
+        from p = 0 (projection warm-starts from the live p field)."""
+        self.state["p"] = jnp.zeros_like(self.state["p"])
+
+    def _resilience_rebuild_poisson(self, two_level=None,
+                                    maxiter_mult: int = 1):
+        """Escalation stages 'tile-only' / 'iter-bump': rebuild every
+        solver-bearing executable with the two-level preconditioner
+        dropped and/or a bumped iteration budget.  Clears the bucketed
+        caches (the solver is baked into them) — a deliberate, counted
+        retrace on the failure path only."""
+        self._poisson_two_level = two_level
+        self._poisson_maxiter = 1000 * int(maxiter_mult)
+        self._solver_core = None
+        self._exec_cache.clear()
+        self._table_memo.clear()  # memo carries the coarse graph
+        self._rebuild()
+
+    def simulate(self):
+        from cup3d_tpu.resilience.recovery import RecoveryEngine
+
+        cfg = self.cfg
+        eng = RecoveryEngine.install(self)
+        try:
+            while True:
+                if eng is not None and eng.on_loop_top():
+                    continue  # rolled back: restart the iteration
+                try:
+                    dt = self.calc_max_timestep()
+                    if cfg.verbose:
+                        print(
+                            f"cup3d_tpu[amr]: step: {self.step_idx},"
+                            f" time: {self.time:f},"
+                            f" dt: {dt:.3e}, blocks: {self.grid.nb}"
+                        )
+                    self.advance(dt)
+                except Exception as e:
+                    if eng is not None and eng.handle_failure(e):
+                        continue  # rolled back: retry from the snapshot
+                    raise
+                done_t = cfg.tend > 0 and self.time >= cfg.tend - 1e-12
+                done_n = cfg.nsteps > 0 and self.step_idx >= cfg.nsteps
+                if done_t or done_n:
+                    break
+            self.flush_packs()
+            self.drain_streams()
+            self.logger.flush()
+        finally:
+            if eng is not None:
+                eng.uninstall()

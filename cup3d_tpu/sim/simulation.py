@@ -19,6 +19,8 @@ from cup3d_tpu.config import SimulationConfig, parse_factory
 from cup3d_tpu.obs import trace as obs_trace
 from cup3d_tpu.obs.flight import FlightRecorder
 from cup3d_tpu.ops import diagnostics as diag
+from cup3d_tpu.resilience import faults
+from cup3d_tpu.resilience.recovery import SimulationFailure
 from cup3d_tpu.sim import operators as ops
 from cup3d_tpu.sim.data import SimulationData
 
@@ -65,6 +67,9 @@ class Simulation:
             stream=self._pack_reader, kind="uniform",
         )
         self._last_umax: Optional[float] = None
+        # round-10 resilience: simulate() installs a RecoveryEngine here
+        # (CUP3D_RECOVER=1, the default); None = legacy crash-on-fault
+        self._resilience = None
 
     # -- setup (reference init(), main.cpp:15163-15178) --------------------
 
@@ -145,6 +150,10 @@ class Simulation:
             "uinf": [float(v) for v in s.uinf],
             "obstacles": [type(ob).__name__ for ob in s.obstacles],
             "stream": self._pack_reader.snapshot(),
+            # round 10: the async writers' health rides in postmortems
+            # (latched background failures, drop counts)
+            "checkpointer": self._checkpointer.health(),
+            "dumper": self._dumper.health(),
         }
 
     # -- time stepping -----------------------------------------------------
@@ -153,6 +162,10 @@ class Simulation:
         """CFL dt with diffusive cap and log ramp-up (main.cpp:15254-15305)."""
         s, cfg = self.sim, self.cfg
         h = s.grid.h
+        if faults.fire("step.nan_velocity", s.step):
+            # injected fault (resilience/faults.py): poison the max|u|
+            # mirror so the EXISTING NaN-umax abort below detects it
+            self._umax_next = float("nan")
         if self._umax_next is not None:
             umax = self._umax_next
             if not self.cfg.pipelined:
@@ -192,13 +205,14 @@ class Simulation:
             s.logger.flush()
             # postmortem BEFORE the raise: ring contents, residual
             # history, last-known-good step (obs/flight.py)
-            self.flight.trigger(
-                "nan-velocity" if not np.isfinite(umax)
-                else "runaway-velocity",
-                extra={"step": s.step, "umax": umax},
-            )
-            raise RuntimeError(
-                f"runaway velocity: max|u|={umax:.3g} > uMax_allowed={cfg.uMax_allowed}"
+            reason = ("nan-velocity" if not np.isfinite(umax)
+                      else "runaway-velocity")
+            extra = {"step": s.step, "umax": umax}
+            self.flight.trigger(reason, extra=extra)
+            raise SimulationFailure(
+                reason,
+                f"runaway velocity: max|u|={umax:.3g} > uMax_allowed={cfg.uMax_allowed}",
+                extra,
             )
         if cfg.dt > 0:
             s.dt = cfg.dt
@@ -225,14 +239,21 @@ class Simulation:
                 s.dt = min(s.dt, 1.03 * prev_dt)
             if cfg.tend > 0:
                 s.dt = min(s.dt, cfg.tend - s.time)
+        if self._resilience is not None:
+            # retry dt halving (exact no-op at scale 1.0, so the armed
+            # clean path stays bitwise-identical to CUP3D_RECOVER=0)
+            s.dt = self._resilience.scale_dt(s.dt)
+        if faults.fire("dt.collapse", s.step):
+            # injected fault: collapse dt so the existing abort trips
+            s.dt = float("nan")
         if not np.isfinite(s.dt) or s.dt <= 0:
             # dt policy collapse: a non-finite or non-positive dt would
             # loop forever / poison every field — dump and abort
-            self.flight.trigger(
-                "dt-collapse",
-                extra={"step": s.step, "dt": s.dt, "umax": umax},
+            extra = {"step": s.step, "dt": s.dt, "umax": umax}
+            self.flight.trigger("dt-collapse", extra=extra)
+            raise SimulationFailure(
+                "dt-collapse", f"dt policy collapse: dt={s.dt:.3g}", extra
             )
-            raise RuntimeError(f"dt policy collapse: dt={s.dt:.3g}")
         # lambda = DLM/dt each step (main.cpp:15302-15303)
         if cfg.DLM > 0:
             s.lambda_penal = cfg.DLM / s.dt
@@ -250,7 +271,29 @@ class Simulation:
             with s.profiler("Checkpoint"):
                 # async snapshot: fields stage via copy_to_host_async and
                 # serialize on the writer thread (stream/checkpoint.py)
-                self._checkpointer.save(self)
+                self._save_checkpoint_guarded()
+
+    def _save_checkpoint_guarded(self) -> None:
+        """Async checkpoint with the round-10 degradation policy: under
+        recovery, a failed background write (surfaced by the
+        AsyncCheckpointer on the NEXT save) falls back to ONE synchronous
+        atomic write; if that fails too the checkpoint is dropped +
+        counted — output must never kill the step loop.  Without
+        recovery the failure propagates (the legacy baseline)."""
+        from cup3d_tpu.obs import metrics as obs_metrics
+
+        try:
+            self._checkpointer.save(self)
+        except Exception:
+            if self._resilience is None:
+                raise
+            obs_metrics.counter("resilience.ckpt_sync_fallbacks").inc()
+            try:
+                from cup3d_tpu.io.checkpoint import save_checkpoint
+
+                save_checkpoint(self)
+            except Exception:
+                obs_metrics.counter("resilience.ckpt_dropped").inc()
 
     def dump_fields(self) -> None:
         import os
@@ -271,14 +314,24 @@ class Simulation:
             with s.profiler("Dump"):
                 # async staged handoff: the sharded multi-writer runs off
                 # the step loop (stream/dump.py)
-                self._dumper.submit(prefix, s.time, s.grid, fields)
+                self._dumper.submit(prefix, s.time, s.grid, fields,
+                                    step=s.step)
 
     def drain_streams(self) -> None:
         """Join all off-critical-path output (pending dumps/checkpoints,
         trace writer) — run end, and anything that must observe the files
         on disk."""
         self._dumper.wait()
-        self._checkpointer.wait()
+        try:
+            self._checkpointer.wait()
+        except Exception:
+            # under recovery a failed final checkpoint write must not
+            # fail an otherwise-complete run: drop + count
+            if self._resilience is None:
+                raise
+            from cup3d_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.counter("resilience.ckpt_dropped").inc()
         obs_trace.TRACE.flush()
 
     def advance(self, dt: float) -> None:
@@ -386,17 +439,90 @@ class Simulation:
         before dumps, checkpoints, and at run end (pipelined mode)."""
         self._pack_reader.flush()
 
-    def simulate(self) -> None:
+    # -- resilience hooks (resilience/recovery.py driver contract) ---------
+
+    def _resilience_restore(self, payload: dict) -> None:
+        """In-place rollback to a ``build_payload``-shaped in-memory
+        snapshot (the uniform twin of ``io.checkpoint.load_checkpoint``,
+        reusing the live pipeline/jits so the retry costs zero
+        retraces).  Fields are re-copied on the way in: the step jits
+        donate them, and the engine's snapshot must survive repeated
+        restores."""
+        import pickle
+
+        import jax.numpy as jnp
+
+        s = self.sim
+        s.state = {k: jnp.copy(v) for k, v in payload["fields"].items()}
+        s.time = float(payload["time"])
+        s.step = int(payload["step"])
+        s.dt = float(payload["dt"])
+        s.uinf = np.asarray(payload["uinf"], np.float64)
+        s.lambda_penal = float(payload["lambda_penal"])
+        s.cadence.next_dump = float(payload["next_dump"])
+        s.obstacles = pickle.loads(payload["obstacles"])
+        for ob in s.obstacles:
+            ob.sim = s
+        s.pending_parts = []
+        s._uinf_dev = None
+        self._umax_next = None
+        self._last_umax = None
+        # mirrors queued from the abandoned trajectory must never apply
+        self._pack_reader.abandon()
+        if s.obstacles:
+            self.pipeline[0](0.0)  # CreateObstacles: rebuild chi/udef
+
+    def _resilience_zero_pressure(self) -> None:
+        """Escalation stage 'zero-guess': the warm start restarts from
+        p = 0 (the solvers warm-start from the live pressure field)."""
+        import jax.numpy as jnp
+
+        self.sim.state["p"] = jnp.zeros_like(self.sim.state["p"])
+
+    def _resilience_rebuild_poisson(self, two_level=None,
+                                    maxiter_mult: int = 1) -> None:
+        """Escalation stages 'tile-only' / 'iter-bump': rebuild the
+        Poisson solve with the two-level preconditioner dropped and/or a
+        bumped iteration budget.  A deliberate one-off retrace on the
+        failure path (the spectral solver is direct and ignores both)."""
+        from cup3d_tpu.ops.poisson import make_poisson_solver
+
         s, cfg = self.sim, self.cfg
-        while True:
-            dt = self.calc_max_timestep()
-            if cfg.verbose:
-                print(f"cup3d_tpu: step: {s.step}, time: {s.time:f}, dt: {dt:.3e}")
-            self.advance(dt)
-            done_t = cfg.tend > 0 and s.time >= cfg.tend - 1e-12
-            done_n = cfg.nsteps > 0 and s.step >= cfg.nsteps
-            if done_t or done_n:
-                break
-        self.flush_packs()
-        self.drain_streams()
-        s.logger.flush()
+        s.poisson_solver = make_poisson_solver(
+            s.grid, cfg.poissonSolver, s.dtype, tol_abs=cfg.poissonTol,
+            tol_rel=cfg.poissonTolRel, maxiter=1000 * int(maxiter_mult),
+            mean_constraint=cfg.bMeanConstraint, two_level=two_level,
+        )
+        for i, op in enumerate(self.pipeline):
+            if isinstance(op, ops.PressureProjection):
+                self.pipeline[i] = ops.PressureProjection(s)
+
+    def simulate(self) -> None:
+        from cup3d_tpu.resilience.recovery import RecoveryEngine
+
+        s, cfg = self.sim, self.cfg
+        eng = RecoveryEngine.install(self)
+        try:
+            while True:
+                if eng is not None and eng.on_loop_top():
+                    continue  # rolled back: restart the iteration
+                try:
+                    dt = self.calc_max_timestep()
+                    if cfg.verbose:
+                        print(f"cup3d_tpu: step: {s.step}, time: {s.time:f},"
+                              f" dt: {dt:.3e}")
+                    self.advance(dt)
+                except Exception as e:
+                    if eng is not None and eng.handle_failure(e):
+                        continue  # rolled back: retry from the snapshot
+                    raise
+                done_t = cfg.tend > 0 and s.time >= cfg.tend - 1e-12
+                done_n = cfg.nsteps > 0 and s.step >= cfg.nsteps
+                if done_t or done_n:
+                    break
+            self.flush_packs()
+            self.drain_streams()
+            s.logger.flush()
+        finally:
+            if eng is not None:
+                eng.uninstall()

@@ -48,7 +48,13 @@ class AsyncCheckpointer:
         self.max_pending = max_pending
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pending: List = []
-        self.stats = {"saves": 0, "snapshot_s": 0.0, "write_s": 0.0}
+        # round-10 fix (ISSUE 5 satellite): a background write failure
+        # used to be silently lost unless someone happened to .result()
+        # the future — now the FIRST failure is latched here, propagated
+        # by the next save()/wait(), and visible through health()
+        self._failed: Optional[BaseException] = None
+        self.stats = {"saves": 0, "snapshot_s": 0.0, "write_s": 0.0,
+                      "write_failures": 0}
         # per-instance stats surfaced process-wide through the obs
         # registry (weakref collector, like stream/qoi.py)
         import weakref
@@ -63,12 +69,49 @@ class AsyncCheckpointer:
 
         obs_metrics.register_collector(_collect, owner=self)
 
+    def _reap_done(self) -> None:
+        """Retire completed write futures, latching the first failure
+        (the executor would otherwise swallow it forever)."""
+        still = []
+        for fut in self._pending:
+            if not fut.done():
+                still.append(fut)
+                continue
+            try:
+                fut.result()
+            except Exception as e:
+                if self._failed is None:
+                    self._failed = e
+        self._pending = still
+
+    def health(self) -> dict:
+        """Driver-pollable liveness: {ok, pending, saves,
+        write_failures, error}.  ``ok`` is False while an unpropagated
+        background failure is latched."""
+        self._reap_done()
+        return {
+            "ok": self._failed is None,
+            "pending": len(self._pending),
+            "saves": self.stats["saves"],
+            "write_failures": self.stats["write_failures"],
+            "error": repr(self._failed) if self._failed else None,
+        }
+
     def save(self, driver, path: Optional[str] = None) -> str:
         """Snapshot ``driver`` now; write in the background.  Returns the
-        checkpoint path (the file lands when the write job completes)."""
+        checkpoint path (the file lands when the write job completes).
+        A failure from a PREVIOUS background write is re-raised here
+        (and cleared) before any new snapshot work: callers learn about
+        it at the next save instead of never."""
+        self._reap_done()
+        if self._failed is not None:
+            err, self._failed = self._failed, None
+            raise err
         # jax-lint: allow(JX008, snapshot_s is the checkpointer's native
         # counter, surfaced through the obs collector in __init__; the
         # drivers wrap save() in their Checkpoint profiler span)
+        # jax-lint: allow(JX006, the pre-window calls are host-side
+        # future bookkeeping (_reap_done), not device dispatches)
         t0 = time.perf_counter()
         payload = build_payload(driver)
         # deep-freeze host-mutable obstacle state (device arrays and the
@@ -88,8 +131,11 @@ class AsyncCheckpointer:
                 v = jnp.copy(v)
                 try:
                     v.copy_to_host_async()
+                # jax-lint: allow(JX009, capability probe: platforms
+                # without async copies fall back to the blocking read
+                # in materialize_payload)
                 except Exception:
-                    pass  # platforms without async copies
+                    pass
             fields[k] = v
         payload["fields"] = fields
         if path is None:
@@ -115,7 +161,11 @@ class AsyncCheckpointer:
         # thread — obs spans are main-thread; the counter reaches the
         # registry via the __init__ collector)
         t0 = time.perf_counter()
-        out = write_payload(materialize_payload(payload), path)
+        try:
+            out = write_payload(materialize_payload(payload), path)
+        except Exception:
+            self.stats["write_failures"] += 1
+            raise  # latched by _reap_done / surfaced by save()/wait()
         # jax-lint: allow(JX006, materialize_payload host-reads every
         # staged field inside the window — a transitive sync the AST
         # cannot see; the wall here is true background-write cost)
@@ -123,9 +173,20 @@ class AsyncCheckpointer:
         return out
 
     def wait(self) -> None:
+        """Join all pending writes; re-raises the FIRST failure —
+        including one latched from an earlier, already-reaped write."""
         pending, self._pending = self._pending, []
+        first: Optional[BaseException] = None
         for fut in pending:
-            fut.result()
+            try:
+                fut.result()
+            except Exception as e:
+                if first is None:
+                    first = e
+        if first is None and self._failed is not None:
+            first, self._failed = self._failed, None
+        if first is not None:
+            raise first
 
     def __bool__(self):
         return bool(self._pending)

@@ -120,6 +120,9 @@ def dump_fields_sharded(
     bytes_written = 0
     files = []
     try:
+        # atomic promotion (round 10): every file is fully written to
+        # <path>.tmp and os.replace'd into place, so a kill mid-dump
+        # leaves no truncated raws/indices for tools/post.py to trip on
         # geometry: each shard expands ITS cells to 8 float32 vertices
         # inside its writer (the full vertex array never materializes)
         xyz_path = f"{prefix}.xyz.raw"
@@ -137,7 +140,8 @@ def dump_fields_sharded(
 
         jobs = [(off, geom_bytes(a, b))
                 for (a, b), off in zip(extents, offs)]
-        _pwrite_extents(xyz_path, jobs, ncell * item, pool)
+        _pwrite_extents(f"{xyz_path}.tmp", jobs, ncell * item, pool)
+        os.replace(f"{xyz_path}.tmp", xyz_path)
         bytes_written += ncell * item
         files.append(xyz_path)
 
@@ -151,10 +155,12 @@ def dump_fields_sharded(
             offs = _exscan([(hi - lo) * 4 for lo, hi in extents])
             jobs = [(off, a[lo:hi].tobytes())
                     for (lo, hi), off in zip(extents, offs)]
-            _pwrite_extents(attr_path, jobs, ncell * 4, pool)
+            _pwrite_extents(f"{attr_path}.tmp", jobs, ncell * 4, pool)
+            os.replace(f"{attr_path}.tmp", attr_path)
             bytes_written += ncell * 4
             files.append(attr_path)
-            with open(f"{prefix}.{name}.xdmf2", "w") as f:
+            xdmf_path = f"{prefix}.{name}.xdmf2"
+            with open(f"{xdmf_path}.tmp", "w") as f:
                 f.write(
                     _XDMF.format(
                         time=time_,
@@ -165,7 +171,9 @@ def dump_fields_sharded(
                         attr=os.path.basename(attr_path),
                     )
                 )
-            files.append(f"{prefix}.{name}.xdmf2")
+            # the index is promoted LAST: it only ever names complete raws
+            os.replace(f"{xdmf_path}.tmp", xdmf_path)
+            files.append(xdmf_path)
     finally:
         if pool is not None:
             pool.shutdown()
@@ -188,13 +196,20 @@ class AsyncDumper:
     the oldest write (a dump burst cannot queue unbounded field copies).
     """
 
-    def __init__(self, nshards: int = 0, max_pending: int = 2):
+    def __init__(self, nshards: int = 0, max_pending: int = 2,
+                 retries: int = 2):
         self.nshards = nshards
         self.max_pending = max_pending
+        self.retries = retries
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pending: List = []
+        # round-10 degradation contract: a dump that still fails after
+        # the retries is DROPPED and counted — snapshots are lossy
+        # telemetry, and output must never crash the step loop.  The
+        # last error stays visible through health().
+        self._last_error: Optional[BaseException] = None
         self.stats = {"dumps": 0, "bytes_written": 0, "write_s": 0.0,
-                      "submit_s": 0.0}
+                      "submit_s": 0.0, "write_failures": 0, "dropped": 0}
         # per-instance stats surfaced process-wide through the obs
         # registry (weakref collector; equal keys from live dumpers sum)
         import weakref
@@ -209,8 +224,22 @@ class AsyncDumper:
 
         obs_metrics.register_collector(_collect, owner=self)
 
+    def health(self) -> dict:
+        """Driver-pollable liveness: {ok, pending, dumps, dropped,
+        write_failures, error} — ``ok`` is False once a dump has been
+        dropped (the run keeps going; the loss is visible here and in
+        the ``dump.dropped`` registry counter)."""
+        return {
+            "ok": self.stats["dropped"] == 0,
+            "pending": len(self._pending),
+            "dumps": self.stats["dumps"],
+            "dropped": self.stats["dropped"],
+            "write_failures": self.stats["write_failures"],
+            "error": repr(self._last_error) if self._last_error else None,
+        }
+
     def submit(self, prefix: str, time_: float, grid,
-               fields: Dict[str, "object"]) -> None:
+               fields: Dict[str, "object"], step=None) -> None:
         # jax-lint: allow(JX008, submit_s is the dumper's native counter,
         # surfaced process-wide through the obs collector in __init__;
         # drivers additionally wrap submit in their Dump profiler span)
@@ -219,8 +248,11 @@ class AsyncDumper:
         for name, arr in fields.items():
             try:
                 arr.copy_to_host_async()
+            # jax-lint: allow(JX009, capability probe: numpy arrays and
+            # platforms without async copies fall back to the blocking
+            # np.asarray in _write)
             except Exception:
-                pass  # numpy arrays / platforms without async copies
+                pass
             staged[name] = arr
         if self._pool is None:
             self._pool = ThreadPoolExecutor(
@@ -229,7 +261,8 @@ class AsyncDumper:
         while len(self._pending) >= self.max_pending:
             self._pending.pop(0).result()
         self._pending.append(
-            self._pool.submit(self._write, prefix, time_, grid, staged)
+            self._pool.submit(self._write, prefix, time_, grid, staged,
+                              step)
         )
         self.stats["dumps"] += 1
         # jax-lint: allow(JX006, submit_s measures the HOST staging cost
@@ -237,14 +270,36 @@ class AsyncDumper:
         # awaited — the background _write syncs when it lands)
         self.stats["submit_s"] += time.perf_counter() - t0
 
-    def _write(self, prefix, time_, grid, staged):
+    def _write(self, prefix, time_, grid, staged, step=None):
         # jax-lint: allow(JX008, write_s runs on the background writer
         # thread — obs spans are main-thread (SpanTimer stack); the
         # counter reaches the registry via the __init__ collector)
         t0 = time.perf_counter()
         host = {k: np.asarray(v) for k, v in staged.items()}
-        out = dump_fields_sharded(prefix, time_, grid, host,
-                                  nshards=self.nshards)
+        from cup3d_tpu.resilience import faults, writeguard
+
+        out = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                writeguard.backoff_sleep(attempt)
+            try:
+                # dump.write_fail injection seam: fires per attempt
+                # while armed (persistent failure = multi-count arm)
+                faults.maybe_raise("dump.write_fail", step)
+                out = dump_fields_sharded(prefix, time_, grid, host,
+                                          nshards=self.nshards)
+                break
+            except Exception as e:
+                self.stats["write_failures"] += 1
+                self._last_error = e
+        if out is None:
+            # retries exhausted: drop + count, never crash the step loop
+            # (checkpoints are the durable artifact; dumps are lossy)
+            self.stats["dropped"] += 1
+            from cup3d_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.counter("dump.write_dropped").inc()
+            return None
         self.stats["bytes_written"] += out["bytes_written"]
         self.stats["write_s"] += time.perf_counter() - t0
         return out

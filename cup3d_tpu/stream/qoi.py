@@ -139,6 +139,7 @@ class QoIStream:
         return {
             "packs_emitted": 0,
             "packs_consumed": 0,
+            "packs_abandoned": 0,
             "groups_started": 0,
             "groups_read": 0,
             "parts_dropped": 0,
@@ -186,14 +187,22 @@ class QoIStream:
         pack = jnp.concatenate([a.astype(dtype) for _, a in kept])
         try:
             pack.copy_to_host_async()
+        # jax-lint: allow(JX009, capability probe: platforms without
+        # async copies fall back to the blocking read downstream)
         except Exception:
-            pass  # platforms without async copies: the read below blocks
+            pass
         entry = {"layout": [(n, int(a.shape[0])) for n, a in kept],
                  "pack": pack}
         entry.update(meta)
         return entry
 
     def emit(self, entry: dict) -> None:
+        from cup3d_tpu.resilience import faults
+
+        # stream.stall injection seam (resilience/faults.py): a
+        # simulated tunnel stall lands in the stream's own stall
+        # accounting; the unarmed probe is one tuple scan
+        faults.maybe_stall(step=entry.get("step"))
         self.queue.append(entry)
         self.stats["packs_emitted"] += 1
         self.poll()
@@ -226,8 +235,10 @@ class QoIStream:
         batch = jnp.concatenate([e["pack"] for e in group])
         try:
             batch.copy_to_host_async()
+        # jax-lint: allow(JX009, capability probe: platforms without
+        # async copies fall back to the blocking asarray downstream)
         except Exception:
-            pass  # platforms without async copies: asarray below blocks
+            pass
         self._inflight.append({"batch": batch, "group": group})
         self.stats["kicks"] += 1
         self.stats["groups_started"] += 1
@@ -244,10 +255,14 @@ class QoIStream:
         caller reads it later with ``np.asarray`` (~free once landed)."""
         try:
             x.copy_to_host_async()
+        # jax-lint: allow(JX009, capability probe: platforms without
+        # async copies fall back to the caller's blocking asarray)
         except Exception:
             pass
         try:
             self.stats["bytes_staged"] += int(x.size) * x.dtype.itemsize
+        # jax-lint: allow(JX009, best-effort byte accounting on duck-
+        # typed staged values; the stage itself already succeeded)
         except Exception:
             pass
         return x
@@ -304,3 +319,13 @@ class QoIStream:
             entry = self.queue.pop(0)
             self.consume(entry)
             self.stats["packs_consumed"] += 1
+
+    def abandon(self) -> None:
+        """Drop every queued pack and in-flight group WITHOUT consuming
+        them — recovery rollback (resilience/recovery.py): mirrors from
+        the abandoned trajectory must never apply to the restored
+        state.  Counted in ``packs_abandoned``."""
+        n = len(self.queue) + sum(len(h["group"]) for h in self._inflight)
+        self.queue = []
+        self._inflight = []
+        self.stats["packs_abandoned"] += n

@@ -1,6 +1,7 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX008
-# incl. the JX007 jit-in-regrid-loop and JX008 timing-outside-obs rules)
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX009
+# incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs and JX009
+# swallowed-exception rules)
 # + the obs trace schema selftest (tools/trace_check.py) + bytecode
 # compile of the whole package.  Nonzero exit on any non-baselined lint
 # finding or any syntax error.  The shipped tree carries an EMPTY
@@ -25,6 +26,11 @@ python -m cup3d_tpu.analysis $PATHS -q
 # identifiable at a glance in CI logs (ISSUE 3 satellite)
 echo "== python -m cup3d_tpu.analysis --rules JX007 $PATHS"
 python -m cup3d_tpu.analysis --rules JX007 $PATHS -q
+
+# the swallowed-exception rule on its own line (ISSUE 5 satellite): a
+# new silent `except: pass` outside resilience/ fails CI identifiably
+echo "== python -m cup3d_tpu.analysis --rules JX009 $PATHS"
+python -m cup3d_tpu.analysis --rules JX009 $PATHS -q
 
 # obs trace schema: producer -> validator round trip without a sim
 # (ISSUE 4 satellite; validates real traces with an argument instead)
