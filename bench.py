@@ -13,6 +13,9 @@ Also reported inside the same single JSON line:
 - BiCGSTAB iterations-to-tolerance and iterations/sec on the fish state's
   actual pressure system, cold and warm-started;
 - max |div u| after projection (the correctness gate, main.cpp:8889-8919);
+- the K-step scan megaloop's host/device split on the same driver
+  (scan_k, host_dispatch_s, wall vs device execution — round 11), gated
+  at wall <= 2x device (gates.fish128_wall_vs_device);
 - secondary configs: 256^3 Taylor-Green with the iterative solver,
   the 256^3 spectral-projection step (round-1's headline), and the run.sh
   two-fish adaptive-mesh case (wall/step, blocks, div).
@@ -251,6 +254,70 @@ def _recover_overhead(driver, calc_dt, sync_state, baseline_wall: float,
     }
 
 
+def _megaloop_split(sim, dispatches: int = 4):
+    """Round 11 host/device split of the K-step scan megaloop on the live
+    fish driver.  Two windows over ``advance_megaloop``:
+
+    - device window: block after every dispatch, so the per-step figure
+      is the device execution cost of K fused steps (midline, chi, rigid
+      update, projection, probe — all inside one ``lax.scan``);
+    - wall window: dispatches run back-to-back with one closing sync —
+      the sustained per-step wall — while ``host_dispatch_s`` accumulates
+      the host-side time of each dispatch call (CFL ramp precompute,
+      carry rebind, QoI emit).
+
+    The gate is the tentpole's acceptance bar: the sustained wall must
+    stay within 2x the device execution — i.e. the host residue the scan
+    was built to kill (BENCH_r05's ~28-43 ms/step of midline re-eval and
+    SDF re-staging) stays dead."""
+    import jax
+
+    from cup3d_tpu.sim import megaloop as ml
+
+    k_cfg = ml.resolve_scan_k(sim.cfg)
+    sim._scan_k = k_cfg if k_cfg >= 1 else ml.DEFAULT_SCAN_K
+    if not (sim._megaloop_eligible() and sim._scan_ready()):
+        sim._scan_k = 0
+        return {"scan_k": 0, "skipped": "megaloop ineligible"}
+    K = sim._scan_k
+    s = sim.sim
+
+    def sync():
+        return s.state["vel"]
+
+    for _ in range(2):  # compile the scan + settle the carry, untimed
+        sim.advance_megaloop()
+    jax.block_until_ready(sync())
+    with _maybe_trace("fish_megaloop"):
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            sim.advance_megaloop()
+            jax.block_until_ready(sync())
+        device_s = (time.perf_counter() - t0) / (dispatches * K)
+        host = 0.0
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            t1 = time.perf_counter()
+            sim.advance_megaloop()
+            host += time.perf_counter() - t1
+        jax.block_until_ready(sync())
+        wall_s = (time.perf_counter() - t0) / (dispatches * K)
+    # hand the driver back to the per-step path with current mirrors
+    sim.flush_packs()
+    sim._scan_carry = None
+    sim._scan_k = 0
+    ratio = wall_s / max(device_s, 1e-9)
+    return {
+        "scan_k": K,
+        "wall_per_step_s": round(wall_s, 5),
+        "wall_per_step_device_s": round(device_s, 5),
+        "host_dispatch_s": round(host / (dispatches * K), 5),
+        "wall_vs_device": round(ratio, 3),
+        "wall_vs_device_gate": 2.0,
+        "wall_vs_device_gate_ok": bool(ratio <= 2.0),
+    }
+
+
 def bench_fish_uniform(n_default: int = 128):
     """BASELINE config #2: uniform self-propelled fish, iterative Poisson
     at 1e-6/1e-4 (CUP3D_BENCH_CONFIG=fish256 runs it at 256^3, the closest
@@ -346,6 +413,11 @@ def bench_fish_uniform(n_default: int = 128):
     recover_gate = _recover_overhead(
         sim, sim.calc_max_timestep, lambda: sim.sim.state["vel"], wall,
     )
+
+    # round-11 scan megaloop: same driver, K steps per dispatch; the
+    # wall-vs-device ratio is the tentpole's host-residue gate
+    mega = _megaloop_split(sim)
+    mega["n"] = n
 
     # BiCGSTAB microbenchmark on the production pressure system: advance
     # the pipeline up to (but excluding) PressureProjection so the rhs is
@@ -443,6 +515,7 @@ def bench_fish_uniform(n_default: int = 128):
         "obs_delta": obs_delta,
         **trace_gate,
         **recover_gate,
+        "megaloop": mega,
         "roofline": _lanes_roofline(A, M, rhs),
         "per_operator_mean_s": prof,
         "n": n,
@@ -1075,6 +1148,17 @@ def _compact_summary(out: dict) -> dict:
                 "gate": d.get("recover_overhead_gate"),
                 "ok": d["recover_overhead_gate_ok"],
             }
+        m = d.get("megaloop")
+        if isinstance(m, dict) and "wall_vs_device_gate_ok" in m:
+            # the round-11 acceptance bar, e.g. fish128_wall_vs_device
+            gk = f"fish{m.get('n', '')}_wall_vs_device"
+            if gk not in gates:  # fish_run2 repeats the headline config
+                gates[gk] = {
+                    "scan_k": m.get("scan_k"),
+                    "ratio": m.get("wall_vs_device"),
+                    "gate": m.get("wall_vs_device_gate"),
+                    "ok": m["wall_vs_device_gate_ok"],
+                }
         for k in ("sync_qoi_s", "stream_stall_s", "stream_bytes"):
             if k in d:
                 compact.setdefault("stream", {}).setdefault(key, {})[k] = d[k]

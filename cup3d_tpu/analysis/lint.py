@@ -42,6 +42,12 @@ Rule summary (full rationale in ``analysis/rules.py``):
          leaves no counter, no state, no re-raise.  ``cup3d_tpu/
          resilience/`` is exempt by path — containing already-counted
          failures is its job.
+- JX010  per-step host<->device staging of obstacle state:
+         ``np.asarray``/``jnp.asarray`` on a loop-carried attribute
+         (``self.X``/``ob.X``/``s.X``) inside a step-loop function in
+         ``sim/``, ``ops/``, ``stream/`` or ``models/`` — the residue
+         the megaloop work removed (cache the mirror identity-keyed,
+         derive it on device, or carry it in the scan state).
 """
 
 from __future__ import annotations
@@ -86,6 +92,23 @@ JNP_CONSTRUCTORS = frozenset(
 
 #: calls that force (or are) a device sync, for JX001/JX006
 SYNC_BUILTINS = frozenset({"float", "int", "bool"})
+
+#: JX010 scope: the obstacle pipeline's step-loop modules.  Wider than
+#: HOT_MODULE_RE because models/ operator ``__call__``s ARE the per-step
+#: obstacle path even though they hold no device kernels of their own.
+JX010_MODULE_RE = re.compile(r"cup3d_tpu/(sim|ops|stream|models)/")
+
+#: receiver names whose attributes are loop-carried obstacle/driver
+#: state by this repo's conventions (JX010): obstacle mirrors live on
+#: ``ob``/``obstacle``/``self``, driver scalars on ``s``/``sim``/``self``
+JX010_STATE_ROOTS = frozenset({"self", "s", "sim", "ob", "obstacle"})
+
+#: the staging constructors JX010 watches (both directions: np.asarray
+#: is a device->host read when the mirror went device-resident,
+#: jnp.asarray a host->device upload of the same bytes every step)
+ASARRAY_NAMES = frozenset(
+    {"np.asarray", "numpy.asarray", "jnp.asarray", "jax.numpy.asarray"}
+)
 
 #: array attributes that live on the HOST side of a jax Array (reading
 #: them never syncs), so int(x.size) etc. is not a JX001 hit
@@ -349,6 +372,10 @@ class FileLint:
             self._check_timing_windows(func, qualname)      # JX006
             self._check_manual_timing(func, qualname)       # JX008
             self._check_swallowed_exceptions(func, qualname)  # JX009
+            if JX010_MODULE_RE.search(self.path) and bool(
+                HOT_FUNC_RE.match(func.name)
+            ):
+                self._check_obstacle_staging(func, qualname)  # JX010
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         return self.violations
@@ -413,14 +440,12 @@ class FileLint:
 
     # -- JX001 -------------------------------------------------------------
 
-    def _check_host_sync(self, func: ast.AST, qualname: str) -> None:
-        taint = _Taint()
-        for stmt in _walk_shallow(func):
-            if isinstance(stmt, ast.stmt):
-                taint.feed(stmt)
-        # `with sanctioned_transfer("tag"):` IS the designed-sync-point
-        # annotation — the runtime guard and the lint agree on the same
-        # marker, so a site is never annotated twice
+    def _sanction_lookup(self, func: ast.AST):
+        """line -> tag for `with sanctioned_transfer("tag"):` spans.
+
+        The sanctioned block IS the designed-sync-point annotation — the
+        runtime guard and the lint (JX001/JX010) agree on the same
+        marker, so a site is never annotated twice."""
         sanctioned: List[Tuple[int, int, str]] = []
         for node in _walk_shallow(func):
             if isinstance(node, ast.With):
@@ -443,6 +468,14 @@ class FileLint:
                     return tag or "sanctioned"
             return None
 
+        return sanction_tag
+
+    def _check_host_sync(self, func: ast.AST, qualname: str) -> None:
+        taint = _Taint()
+        for stmt in _walk_shallow(func):
+            if isinstance(stmt, ast.stmt):
+                taint.feed(stmt)
+        sanction_tag = self._sanction_lookup(func)
         for node in _walk_shallow(func):
             if not isinstance(node, ast.Call):
                 continue
@@ -488,6 +521,53 @@ class FileLint:
                     "np.asarray() of a device value is a blocking "
                     "device->host transfer",
                 )
+
+    # -- JX010 -------------------------------------------------------------
+
+    def _check_obstacle_staging(self, func: ast.AST, qualname: str) -> None:
+        """{np,jnp}.asarray on a ``self.X``/``ob.X``/``s.X`` attribute
+        inside a step-loop function: the same obstacle/driver mirror
+        crosses the host boundary again every step.  Precision-first like
+        the rest of the linter — only attribute reads off the
+        conventional state receivers fire, and ``sanctioned_transfer``
+        blocks suppress just as they do for JX001."""
+        sanction_tag = self._sanction_lookup(func)
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = _call_name(node)
+            if name not in ASARRAY_NAMES:
+                continue
+            arg = node.args[0]
+            if not isinstance(arg, ast.Attribute) or _is_host_metadata(arg):
+                continue
+            root = arg
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if not (isinstance(root, ast.Name)
+                    and root.id in JX010_STATE_ROOTS):
+                continue
+            direction = (
+                "host->device upload"
+                if name.split(".", 1)[0].lstrip("_") in ("jnp", "jax")
+                else "device->host read"
+            )
+            n_before = len(self.violations)
+            self._emit(
+                "JX010", node, qualname,
+                f"{name}({_dotted(arg)}) re-stages loop-carried "
+                f"obstacle/driver state every step ({direction}); cache "
+                "the mirror identity-keyed, derive it on device, or "
+                "carry it in the scan state",
+            )
+            tag = sanction_tag(node.lineno)
+            if tag is not None:
+                for v in self.violations[n_before:]:
+                    if not v.suppressed:
+                        v.suppressed = True
+                        v.suppression_reason = (
+                            f"sanctioned_transfer({tag!r})"
+                        )
 
     # -- JX002 -------------------------------------------------------------
 

@@ -116,6 +116,23 @@ RULES: Dict[str, Rule] = {
             "failures it has already counted) is exempt by path.",
         ),
         Rule(
+            "JX010",
+            "per-step host<->device staging of obstacle state",
+            "np.asarray/jnp.asarray on a loop-carried obstacle/driver "
+            "attribute (self.X / ob.X / s.X) inside a step-loop function "
+            "re-stages the same mirror across the host boundary every "
+            "step: construction-time constants (bForcedInSimFrame, "
+            "bBlockRotation) and per-step scalars (lambda = DLM/dt) each "
+            "cost a host->device upload per step, and np.asarray on a "
+            "device-resident mirror blocks for the round trip.  BENCH_r05 "
+            "measured the residue at ~28-43 ms/step on the fish configs.  "
+            "Cache static mirrors identity-keyed on the obstacle "
+            "(models/base.forced_mask_dev), derive per-step values on "
+            "device from already-uploaded scalars "
+            "(sim/data.lambda_device), or carry the state device-resident "
+            "across steps (sim/megaloop.py).",
+        ),
+        Rule(
             "JX005",
             "float64 dtype literal in device code",
             "A bare float64 dtype in device code either doubles bandwidth "

@@ -58,6 +58,15 @@ class SimulationConfig:
     # 1 = force on (tests).  Obstacle runs keep the host dt: fish midline
     # kinematics consume host time each step.
     dtDevice: int = -1
+    # K-step scan megaloop (sim/megaloop.py): wrap K whole timesteps —
+    # dt policy, fish midline, rasterization, rigid update, penalization,
+    # projection, force probe — in one jitted lax.scan, emitting the QoI
+    # as one (K, ROW) packed block.  0 = off (the per-step loop, seed
+    # behavior); the CUP3D_SCAN_K env var overrides.  Requires pipelined
+    # mode, free dt, a step-count stop, and either no obstacles or a
+    # single frozen-gait StefanFish (megaloop eligibility in
+    # sim/simulation.py).  QoI/log latency grows to K steps.
+    scan_k: int = 0
 
     # -- fluid (main.cpp:15357-15363) --
     nu: float = 1e-3

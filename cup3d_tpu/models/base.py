@@ -306,6 +306,32 @@ class Obstacle:
             return d["pack"][:RIGID_STATE]
         return jnp.asarray(self.rigid_state_vec(), dtype)
 
+    def forced_mask_dev(self) -> jnp.ndarray:
+        """Cached device mirror of ``bForcedInSimFrame``.  The flags are
+        fixed at construction (factory kwargs), so the upload happens
+        once; identity-keyed like SimulationData.uinf_device so an
+        exotic reassignment still invalidates (the PR 2 mirror
+        pattern).  ``*_cache`` attrs are pickle-excluded and rebuild
+        after restore."""
+        if getattr(self, "_forced_src_cache", None) is not self.bForcedInSimFrame:
+            from cup3d_tpu.analysis.runtime import sanctioned_transfer
+
+            with sanctioned_transfer("scalar-upload"):
+                self._forced_dev_cache = jnp.asarray(self.bForcedInSimFrame)
+            self._forced_src_cache = self.bForcedInSimFrame
+        return self._forced_dev_cache
+
+    def block_mask_dev(self) -> jnp.ndarray:
+        """Cached device mirror of ``bBlockRotation`` (see
+        :meth:`forced_mask_dev`)."""
+        if getattr(self, "_block_src_cache", None) is not self.bBlockRotation:
+            from cup3d_tpu.analysis.runtime import sanctioned_transfer
+
+            with sanctioned_transfer("scalar-upload"):
+                self._block_dev_cache = jnp.asarray(self.bBlockRotation)
+            self._block_src_cache = self.bBlockRotation
+        return self._block_dev_cache
+
     def pos_rot_device(self, dtype):
         """(position, rotation-matrix) as device arrays for rasterization:
         from the device rigid pack when pipelined chaining is active (the

@@ -119,8 +119,12 @@ class UpdateObstacles(Operator):
             out = self._rigid(
                 M[0],
                 ob.rigid_state_dev(s.dtype),
-                jnp.asarray(ob.bForcedInSimFrame),
-                jnp.asarray(ob.bBlockRotation),
+                # cached static mirrors (models/base.py): the flags are
+                # construction-time constants — re-staging them with
+                # jnp.asarray every step was pure host->device residue
+                # (lint rule JX010)
+                ob.forced_mask_dev(),
+                ob.block_mask_dev(),
                 s.uinf_device(),
                 jnp.asarray(dt, s.dtype),
             )
@@ -178,9 +182,12 @@ class Penalization(Operator):
         den = jnp.maximum(jnp.sum(chis, axis=0), _EPS)[..., None]
         ubody = num / den
         vel_old = s.state["vel"]
+        dt_dev = jnp.asarray(dt, s.dtype)
         s.state["vel"] = self._penalize(
-            vel_old, s.state["chi"], ubody,
-            jnp.asarray(s.lambda_penal, s.dtype), jnp.asarray(dt, s.dtype),
+            # lambda rides the device (sim/data.lambda_device): DLM/dt
+            # divides on device from the step's dt scalar instead of
+            # re-staging a fresh host float every step (rule JX010)
+            vel_old, s.state["chi"], ubody, s.lambda_device(dt_dev), dt_dev,
         )
         PF = update_penalization_forces(
             s.obstacles, self._penal_force, s.state["vel"], vel_old, dt,
@@ -219,16 +226,23 @@ class ComputeForces(Operator):
             F = probe(ob, d["cm"], d["trans"], d["ang"])
             s.pending_parts.append(("forces", F.reshape(-1)))
             return
+        # host fallback: one batched (n_obs, 3, 3) kinematics upload per
+        # step instead of three per obstacle (rule JX010); the mirrors
+        # here are fresh host values by construction (no device chain)
+        kin = jnp.asarray(
+            np.stack(
+                [
+                    np.stack([ob.centerOfMass, ob.transVel, ob.angVel])
+                    for ob in s.obstacles
+                ]
+            ),
+            s.dtype,
+        )
         F = np.asarray(
             jnp.stack(
                 [
-                    probe(
-                        ob,
-                        jnp.asarray(ob.centerOfMass, s.dtype),
-                        jnp.asarray(ob.transVel, s.dtype),
-                        jnp.asarray(ob.angVel, s.dtype),
-                    )
-                    for ob in s.obstacles
+                    probe(ob, kin[i, 0], kin[i, 1], kin[i, 2])
+                    for i, ob in enumerate(s.obstacles)
                 ]
             )
         )

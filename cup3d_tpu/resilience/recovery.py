@@ -171,6 +171,16 @@ class RecoveryEngine:
             return int(d.step_idx)
         return int(d.sim.step)
 
+    def snapshot_due(self, step: Optional[int] = None) -> bool:
+        """True when :meth:`on_loop_top` will take its cadence snapshot.
+        Scan-megaloop drivers ask BEFORE the loop top and flush their
+        QoI stream first, so the pickled obstacle mirrors match the
+        device carry at the K boundary (VALIDATION.md round 11)."""
+        if step is None:
+            step = self._step()
+        return (self._snap is None
+                or step - self._snap_step >= self.snapshot_every)
+
     def on_loop_top(self) -> bool:
         """Top of every simulate iteration.  Handles failures latched by
         the async pack consumption (returns True after a rollback so the
@@ -186,7 +196,7 @@ class RecoveryEngine:
         if self.attempts and step > self._recovering_until:
             self.attempts = 0
             self.dt_scale = 1.0
-        if self._snap is None or step - self._snap_step >= self.snapshot_every:
+        if self.snapshot_due(step):
             try:
                 self.snapshot()
             except Exception:

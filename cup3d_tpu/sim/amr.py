@@ -169,6 +169,13 @@ class AMRSimulation:
         self._uinf_host_cache = None  # device mirror of self.uinf
         self.nu = cfg.nu
         self.lambda_penal = cfg.lambda_penalization
+        # cached device lambda mirrors (_lambda_device): the DLM constant
+        # uploads once and lambda = DLM/dt divides ON DEVICE from the
+        # step's dt scalar; a static lambda uploads once per value (the
+        # old per-step jnp.asarray(self.lambda_penal) was rule JX010)
+        self._dlm_dev_cache = None
+        self._lambda_dev_cache = None
+        self._lambda_dev_val = None
         self.logger = BufferedLogger(cfg.path4serialization)
         self.profiler = Profiler()
         from cup3d_tpu.io.dump import OutputCadence
@@ -317,6 +324,27 @@ class AMRSimulation:
                 self._uinf_host_cache = jnp.asarray(self.uinf, self.dtype)
             self._uinf_host_src = self.uinf
         return self._uinf_host_cache
+
+    def _lambda_device(self, dt_j):
+        """Device-resident penalization lambda for this step (same
+        contract as sim/data.lambda_device): DLM > 0 divides the cached
+        DLM constant by the step's device dt scalar — zero steady-state
+        host->device traffic; a static lambda uploads once per value.
+        The host ``lambda_penal`` mirror keeps feeding logs/checkpoints."""
+        if self.cfg.DLM > 0:
+            if self._dlm_dev_cache is None:
+                with sanctioned_transfer("scalar-upload"):
+                    self._dlm_dev_cache = jnp.asarray(
+                        self.cfg.DLM, self.dtype
+                    )
+            return self._dlm_dev_cache / dt_j
+        if self._lambda_dev_val != self.lambda_penal:
+            with sanctioned_transfer("scalar-upload"):
+                self._lambda_dev_cache = jnp.asarray(
+                    self.lambda_penal, self.dtype
+                )
+            self._lambda_dev_val = self.lambda_penal
+        return self._lambda_dev_cache
 
     # -- jitted kernels (rebuilt per layout) -------------------------------
 
@@ -1900,7 +1928,7 @@ class AMRSimulation:
                 vel_old = s["vel"]
                 s["vel"] = self._penalize(
                     vel_old, s["chi"], self._body_velocity(),
-                    jnp.asarray(self.lambda_penal, self.dtype), dt_j,
+                    self._lambda_device(dt_j), dt_j,
                 )
                 PF = update_penalization_forces(
                     self.obstacles, self._penal_force, s["vel"], vel_old,
@@ -1995,6 +2023,9 @@ class AMRSimulation:
             slots, b0s = [], []
             for ob in self.obstacles:
                 s_, b0_, _ = block_window_slots(
+                    # jax-lint: allow(JX010, ob.position is the host
+                    # numpy mirror — a host-side copy for the window
+                    # table math, no device value crosses here)
                     self.grid, np.asarray(ob.position), ob.length
                 )
                 # jax-lint: allow(JX004, the window slot tables are host-
@@ -2025,7 +2056,7 @@ class AMRSimulation:
             vel, p, chi, udef, uinf_next, pack = self._megastep(
                 s["vel"], s["p"], chis, udefs, sdfs, rigid, forced,
                 blocked, fixmask, slots, b0s, uinf, dt_j,
-                jnp.asarray(self.lambda_penal, self.dtype),
+                self._lambda_device(dt_j),
             )
             s["vel"], s["p"], s["chi"], s["udef"] = vel, p, chi, udef
             self._uinf_dev = uinf_next

@@ -77,6 +77,14 @@ class SimulationData:
         # obstacle-free runs never hold the (nx,ny,nz,3) array on device.
         self._xc_cache = None
         self._ubody_cache_fn = None
+        # cached device lambda mirrors (lambda_device): the DLM constant
+        # uploads once and lambda = DLM/dt is computed ON DEVICE from the
+        # step's already-uploaded dt scalar; a static lambda uploads once
+        # per value.  The old per-step jnp.asarray(self.lambda_penal)
+        # re-staged a fresh host float every step (lint rule JX010).
+        self._dlm_dev_cache = None
+        self._lambda_dev_cache = None
+        self._lambda_dev_val = None
 
     @property
     def xc(self) -> jnp.ndarray:
@@ -104,6 +112,33 @@ class SimulationData:
     @property
     def chi(self) -> jnp.ndarray:
         return self.state["chi"]
+
+    def lambda_device(self, dt_dev) -> jnp.ndarray:
+        """Device-resident penalization lambda for this step.
+
+        DLM > 0 configurations recompute lambda = DLM/dt every step
+        (main.cpp:15302-15303): the division runs ON DEVICE against the
+        step's dt scalar (already uploaded by advance()), with the DLM
+        constant cached after one sanctioned upload — zero steady-state
+        host->device traffic.  Static-lambda configurations upload once
+        per value.  The host ``lambda_penal`` mirror keeps feeding logs
+        and checkpoints unchanged."""
+        from cup3d_tpu.analysis.runtime import sanctioned_transfer
+
+        if self.cfg.DLM > 0:
+            if self._dlm_dev_cache is None:
+                with sanctioned_transfer("scalar-upload"):
+                    self._dlm_dev_cache = jnp.asarray(
+                        self.cfg.DLM, self.dtype
+                    )
+            return self._dlm_dev_cache / dt_dev
+        if self._lambda_dev_val != self.lambda_penal:
+            with sanctioned_transfer("scalar-upload"):
+                self._lambda_dev_cache = jnp.asarray(
+                    self.lambda_penal, self.dtype
+                )
+            self._lambda_dev_val = self.lambda_penal
+        return self._lambda_dev_cache
 
     def uinf_device(self) -> jnp.ndarray:
         # pipelined mode keeps uinf device-resident (CreateObstacles sets

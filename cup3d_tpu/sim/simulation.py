@@ -70,6 +70,13 @@ class Simulation:
         # round-10 resilience: simulate() installs a RecoveryEngine here
         # (CUP3D_RECOVER=1, the default); None = legacy crash-on-fault
         self._resilience = None
+        # round-11 scan megaloop (sim/megaloop.py): K whole steps per
+        # jitted lax.scan dispatch.  _scan_k resolves at init() (0 =
+        # off, the seed per-step loop); the compiled loop and its
+        # device carry build lazily on first eligible iteration.
+        self._scan_k = 0
+        self._megaloop = None  # (jitted scan fn, row width) once built
+        self._scan_carry = None  # device carry dict between megaloops
 
     # -- setup (reference init(), main.cpp:15163-15178) --------------------
 
@@ -97,6 +104,66 @@ class Simulation:
                         "without -pipelined"
                     )
         ops.initial_conditions(self.sim)
+        from cup3d_tpu.sim.megaloop import resolve_scan_k
+
+        k = resolve_scan_k(self.cfg)
+        self._scan_k = k if (k >= 1 and self._megaloop_eligible()) else 0
+
+    def _megaloop_eligible(self) -> bool:
+        """Static gate for the K-step scan megaloop (config + obstacle
+        shape); the dynamic parts — gait freezability, the step budget
+        tail, a recovery retreat in progress — are re-checked each
+        iteration by :meth:`_scan_ready`."""
+        cfg, s = self.cfg, self.sim
+        if not cfg.pipelined or cfg.dt > 0 or cfg.implicitDiffusion:
+            return False
+        if cfg.tend > 0 or cfg.nsteps <= 0:
+            # done-by-time needs a fresh s.time every step; inside the
+            # scan the host time mirror lags by up to the stream window
+            return False
+        if cfg.uMax_forced > 0 or cfg.bFixMassFlux or cfg.freqDiagnostics:
+            return False  # forcing/diagnostics operators are per-step
+        if not s.obstacles:
+            return True
+        if len(s.obstacles) != 1:
+            return False
+        from cup3d_tpu.models.fish.device_midline import (
+            device_midline_eligible,
+        )
+
+        return device_midline_eligible(s.obstacles[0])
+
+    def _scan_ready(self) -> bool:
+        """True when the next simulate iteration should run as one
+        K-step megaloop: scan enabled, the compiled loop buildable
+        (fish gait freezable), a full K inside the step budget, and no
+        recovery retreat in progress (the per-step path owns the
+        halved-dt re-advance; the scan resumes once the engine retires
+        the attempt)."""
+        K = self._scan_k
+        if K < 1:
+            return False
+        s = self.sim
+        if s.step + K > self.cfg.nsteps:
+            return False  # per-step tail keeps nsteps exact
+        if (self._resilience is not None
+                and self._resilience.dt_scale != 1.0):
+            return False
+        if self._megaloop is None:
+            from cup3d_tpu.sim import megaloop as ml
+
+            if s.obstacles:
+                fn = ml.build_fish_megaloop(s, s.obstacles[0])
+                row_w = ml.FISH_ROW
+            else:
+                fn = ml.build_tgv_megaloop(s)
+                row_w = ml.TGV_ROW
+            if fn is None:
+                # gait not freezable after all: scan off for the run
+                self._scan_k = 0
+                return False
+            self._megaloop = (fn, row_w)
+        return True
 
     def _setup_operators(self) -> None:
         """Pipeline order is the reference's (main.cpp:15229-15246)."""
@@ -369,6 +436,62 @@ class Simulation:
             s.step += 1
             s.time += dt
 
+    def advance_megaloop(self) -> None:
+        """One K-step scan dispatch (sim/megaloop.py): the whole
+        per-step pipeline — dt policy, fish midline, rasterization,
+        rigid update, penalization, projection, force probe — runs
+        inside one jitted ``lax.scan``; the host only precomputes the
+        CFL ramp, dispatches, and emits the (K, ROW) QoI block into the
+        stream.  Host mirrors, logs, and failure detection are applied
+        row by row at consumption (:meth:`_consume_scan_rows`), so the
+        step loop's externally visible sequence is the per-step one, K
+        steps late."""
+        import jax.numpy as jnp
+
+        from cup3d_tpu.sim import dtpolicy
+        from cup3d_tpu.sim import megaloop as ml
+
+        s, cfg = self.sim, self.cfg
+        K = self._scan_k
+        fn, row_w = self._megaloop
+        base_step = s.step
+        with self._obs.step(base_step, s.time, s.dt,
+                            umax=self._last_umax, scan_k=K):
+            self._maybe_dump_save()
+            if self._scan_carry is None:
+                # carry (re)seed from the host mirrors: one sanctioned
+                # upload at scan entry (cold start, post-rollback,
+                # post-fallback), never per step
+                with sanctioned_transfer("scan-carry-upload"):
+                    self._scan_carry = (
+                        ml.init_fish_carry(s, s.obstacles[0])
+                        if s.obstacles else ml.init_tgv_carry(s))
+            # the CFL ramp is a pure function of the step index: host
+            # precompute, shipped once per megaloop
+            cfl = np.asarray([
+                dtpolicy.ramped_cfl(cfg.CFL, base_step + k, cfg.rampup)
+                for k in range(K)
+            ], dtype=s.dtype)
+            with sanctioned_transfer("scan-carry-upload"):
+                cfl_dev = jnp.asarray(cfl)
+            with s.profiler("Megaloop"):
+                carry, rows = fn(self._scan_carry, cfl_dev)
+            self._scan_carry = carry
+            # the megaloop donates its carry: rebind the field state to
+            # the carried arrays so dumps/snapshots/fallback see live
+            # buffers, never donated ones
+            s.state["vel"] = carry["vel"]
+            s.state["p"] = carry["p"]
+            if "chi" in carry:
+                s.state["chi"] = carry["chi"]
+                s.state["udef"] = carry["udef"]
+            with s.profiler("SyncQoI"):
+                entry = self._pack_reader.pack_parts(
+                    [("scan", rows.reshape(K * row_w))], s.dtype,
+                    time=s.time, step=base_step, scan_k=K)
+                self._pack_reader.emit(entry)
+            s.step += K
+
     def _emit_step_pack(self) -> dict:
         """Concatenate every device QoI the step produced (rigid state,
         forces, penalization forces) plus max|u| for a later dt into ONE
@@ -433,6 +556,93 @@ class Simulation:
                     int(entry.get("step", s.step)), seg[1], seg[0],
                     cap=getattr(s.poisson_solver, "maxiter", None),
                 )
+            elif name == "scan":
+                self._consume_scan_rows(entry, seg)
+
+    def _consume_scan_rows(self, entry: dict, seg: np.ndarray) -> None:
+        """Apply one megaloop's (K, ROW) packed QoI block row by row.
+        Each row is one full step's QoI — rigid mirrors, penalization
+        forces, surface forces, solver stats, umax/dt/t — so the host
+        mirrors, force logs, flight ring and failure detection see the
+        SAME per-step sequence the per-step path produces, K steps
+        late (row layouts: sim/megaloop.py FISH_ROW / TGV_ROW)."""
+        from cup3d_tpu.models.base import (
+            log_forces, store_force_qoi, unpack_forces,
+        )
+        from cup3d_tpu.sim import megaloop as ml
+
+        s, cfg = self.sim, self.cfg
+        ob = s.obstacles[0] if s.obstacles else None
+        row_w = ml.FISH_ROW if ob is not None else ml.TGV_ROW
+        rows = seg.reshape(-1, row_w)
+        base_step = int(entry.get("step", s.step))
+        for k in range(rows.shape[0]):
+            row = rows[k]
+            step_k = base_step + k
+            if ob is not None:
+                resid, iters = float(row[52]), float(row[53])
+                umax, dt_k, t_k = (float(row[58]), float(row[59]),
+                                   float(row[60]))
+            else:
+                resid, iters = float(row[0]), float(row[1])
+                umax, dt_k, t_k = (float(row[2]), float(row[3]),
+                                   float(row[4]))
+            # fault seams replay PER STEP at consumption: the injected
+            # poisons land on the host copies, so the whole detection
+            # -> trigger -> rollback chain runs exactly as it does on a
+            # real mid-megaloop failure (resilience/faults.py)
+            if faults.fire("step.nan_velocity", step_k):
+                umax = float("nan")
+            if not np.isfinite(umax) or umax > cfg.uMax_allowed:
+                s.logger.flush()
+                reason = ("nan-velocity" if not np.isfinite(umax)
+                          else "runaway-velocity")
+                extra = {"step": step_k, "umax": umax,
+                         "scan_k": rows.shape[0]}
+                self.flight.trigger(reason, extra=extra)
+                raise SimulationFailure(
+                    reason,
+                    f"runaway velocity: max|u|={umax:.3g} > "
+                    f"uMax_allowed={cfg.uMax_allowed}", extra)
+            if faults.fire("dt.collapse", step_k):
+                dt_k = float("nan")
+            if not np.isfinite(dt_k) or dt_k <= 0:
+                extra = {"step": step_k, "dt": dt_k, "umax": umax,
+                         "scan_k": rows.shape[0]}
+                self.flight.trigger("dt-collapse", extra=extra)
+                raise SimulationFailure(
+                    "dt-collapse",
+                    f"dt policy collapse: dt={dt_k:.3g}", extra)
+            if ob is not None:
+                ob.apply_rigid_pack(row[0:29])
+                ob.myFish.quaternion_internal = np.asarray(
+                    row[54:58], np.float64)
+                ob.penal_force = row[29:32]
+                ob.penal_torque = row[32:35]
+                store_force_qoi(ob, unpack_forces(row[35:52]))
+                log_forces(s.logger, 0, t_k, ob)
+                if ob.bFixFrameOfRef:
+                    # jax-lint: allow(JX010, host-mirror copy: transVel
+                    # is the numpy mirror apply_rigid_pack just wrote —
+                    # no device value crosses here)
+                    s.uinf = -np.asarray(ob.transVel, np.float64)
+                    s._uinf_dev = None
+            if iters >= 0:  # -1 = the solver packs no stats
+                self._obs.note_solver(
+                    step_k, iters, resid,
+                    cap=getattr(s.poisson_solver, "maxiter", None))
+            # per-step flight ring records: the postmortem sees every
+            # scan step, not one blurred megaloop
+            self.flight.record_step({
+                "step": step_k, "t": t_k, "dt": dt_k, "umax": umax,
+                "wall_s": 0.0, "scan": True,
+            })
+            s.time = t_k
+            s.dt = dt_k
+            if cfg.DLM > 0:
+                s.lambda_penal = cfg.DLM / dt_k
+            self._umax_next = umax
+            self._last_umax = umax
 
     def flush_packs(self) -> None:
         """Drain pending QoI packs so host mirrors are current — called
@@ -467,6 +677,10 @@ class Simulation:
         s._uinf_dev = None
         self._umax_next = None
         self._last_umax = None
+        # the scan carry references the abandoned trajectory (and its
+        # donated buffers): reseed from the restored mirrors on the
+        # next megaloop entry
+        self._scan_carry = None
         # mirrors queued from the abandoned trajectory must never apply
         self._pack_reader.abandon()
         if s.obstacles:
@@ -496,6 +710,10 @@ class Simulation:
         for i, op in enumerate(self.pipeline):
             if isinstance(op, ops.PressureProjection):
                 self.pipeline[i] = ops.PressureProjection(s)
+        # the megaloop closed over the replaced solver: rebuild it too
+        # (a second deliberate retrace, failure path only)
+        self._megaloop = None
+        self._scan_carry = None
 
     def simulate(self) -> None:
         from cup3d_tpu.resilience.recovery import RecoveryEngine
@@ -504,14 +722,43 @@ class Simulation:
         eng = RecoveryEngine.install(self)
         try:
             while True:
+                try:
+                    scan_now = self._scan_ready()
+                    if scan_now:
+                        if eng is not None and eng.snapshot_due(s.step):
+                            # K-boundary snapshot consistency: the
+                            # engine pickles host obstacle mirrors, so
+                            # they must be current (equal to the carry)
+                            # before the cadence snapshot fires
+                            self.flush_packs()
+                    elif self._scan_carry is not None:
+                        # leaving scan mode (step-budget tail, recovery
+                        # retreat): drain the stream so mirrors, time
+                        # and dt are current for the per-step path
+                        self.flush_packs()
+                        self._scan_carry = None
+                except Exception as e:
+                    # a flush consumes queued scan rows and can surface
+                    # a latched in-flight failure — same recovery path
+                    if eng is not None and eng.handle_failure(e):
+                        continue
+                    raise
                 if eng is not None and eng.on_loop_top():
                     continue  # rolled back: restart the iteration
                 try:
-                    dt = self.calc_max_timestep()
-                    if cfg.verbose:
-                        print(f"cup3d_tpu: step: {s.step}, time: {s.time:f},"
-                              f" dt: {dt:.3e}")
-                    self.advance(dt)
+                    if scan_now:
+                        if cfg.verbose:
+                            print(f"cup3d_tpu: steps {s.step}.."
+                                  f"{s.step + self._scan_k - 1} "
+                                  f"(scan K={self._scan_k}), "
+                                  f"time: {s.time:f}")
+                        self.advance_megaloop()
+                    else:
+                        dt = self.calc_max_timestep()
+                        if cfg.verbose:
+                            print(f"cup3d_tpu: step: {s.step}, "
+                                  f"time: {s.time:f}, dt: {dt:.3e}")
+                        self.advance(dt)
                 except Exception as e:
                     if eng is not None and eng.handle_failure(e):
                         continue  # rolled back: retry from the snapshot

@@ -65,7 +65,7 @@ class PackPolicy:
     bytes are counted in ``bytes_dropped``.
     """
 
-    REQUIRED = ("umax", "rigid")
+    REQUIRED = ("umax", "rigid", "scan")
 
     def __init__(self, max_part_elems: int = 0, drop: Iterable[str] = (),
                  required: Iterable[str] = REQUIRED):
@@ -271,18 +271,28 @@ class QoIStream:
 
     def _consume_one(self) -> None:
         """Read the oldest in-flight batch (blocking only if its compute /
-        transfer has not landed yet) and apply its entries FIFO."""
+        transfer has not landed yet) and apply its entries FIFO.  The
+        read is timed into ``read_s`` when the batch had landed and
+        ``stall_s`` when it had not (or its readiness was unknowable),
+        and — when the stream has a profiler — into a ``StreamRead`` /
+        ``StreamWait`` section, so a blocking read can never hide
+        inside whichever driver section happened to enclose it (the
+        BENCH_r05 fish256 SyncQoI regression: unattributed device
+        catch-up billed as pack-read host work)."""
         holder = self._inflight.pop(0)
-        was_ready = self._ready(holder["batch"])
+        was_ready = self._ready(holder["batch"]) is True
+        ctx = (self.profiler("StreamRead" if was_ready else "StreamWait")
+               if self.profiler is not None else nullcontext())
         # jax-lint: allow(JX006, the pre-window calls are host
         # bookkeeping (FIFO pop + readiness poll); the timed np.asarray
         # read IS the sync, and stall_s/read_s split on was_ready)
         # jax-lint: allow(JX008, the stall_s/read_s split is the stream's
         # native counter — it feeds the obs registry via the collector
-        # registered in __init__; an obs span here would re-enter the
-        # profiler the stream already reports StreamWait through)
+        # registered in __init__; the StreamWait/StreamRead spans above
+        # are exactly the obs attribution the rule asks for)
         t0 = time.perf_counter()
-        vals = np.asarray(holder["batch"], np.float64)
+        with ctx:
+            vals = np.asarray(holder["batch"], np.float64)
         elapsed = time.perf_counter() - t0
         self.stats["stall_s" if not was_ready else "read_s"] += elapsed
         self.stats["groups_read"] += 1
@@ -295,16 +305,26 @@ class QoIStream:
             self.stats["packs_consumed"] += 1
 
     @staticmethod
-    def _ready(batch) -> bool:
+    def _ready(batch):
+        """True / False from the platform's readiness probe, or None
+        when the probe itself fails.  None means "unknowable", NOT
+        "ready": poll() treating a probe failure as ready turned every
+        opportunistic drain into a BLOCKING read of an unfinished batch
+        — serializing the dispatch loop with device compute once per
+        emit cadence (the fish256 SyncQoI regression, BENCH_r05)."""
         try:
             return bool(batch.is_ready())
+        # jax-lint: allow(JX009, capability probe: duck-typed batches
+        # without is_ready report unknowable readiness; blocking
+        # consumers proceed, the opportunistic poll() skips)
         except Exception:
-            return True  # no readiness probe: treat as ready (read blocks)
+            return None
 
     def poll(self) -> None:
-        """Consume completed reads without blocking (strictly FIFO: stop at
-        the first batch whose computation hasn't landed)."""
-        while self._inflight and self._ready(self._inflight[0]["batch"]):
+        """Consume completed reads without blocking (strictly FIFO: stop
+        at the first batch whose computation hasn't landed or whose
+        readiness cannot be probed)."""
+        while self._inflight and self._ready(self._inflight[0]["batch"]) is True:
             self._consume_one()
 
     def join(self) -> None:

@@ -128,8 +128,8 @@ def test_jx004_loop_construction_fires_and_suppresses():
         "class D:\n"
         "    def advance(self, obs):\n"
         "        outs = []\n"
-        "        for ob in obs:\n"
-        "            outs.append(jnp.asarray(ob.slots))\n"
+        "        for item in obs:\n"
+        "            outs.append(jnp.asarray(item.slots))\n"
         "        return outs\n"
     )
     vs = _failing(src)
@@ -320,6 +320,81 @@ def test_jx009_observable_handlers_and_resilience_are_clean():
     assert not _failing(dropped, "bench.py")
 
 
+def test_jx010_obstacle_staging_fires_and_suppresses():
+    """Per-step re-staging of a loop-carried obstacle/driver attribute
+    ({np,jnp}.asarray on self.X/ob.X/s.X in a step-loop function)."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "class Penalization:\n"
+        "    def __call__(self, dt):\n"
+        "        s = self.sim\n"
+        "        return jnp.asarray(s.lambda_penal, s.dtype)\n"
+    )
+    # models/ is INSIDE the JX010 scope (the operator __call__s are the
+    # per-step obstacle path) even though it is outside HOT_MODULE_RE
+    vs = _failing(src, "cup3d_tpu/models/fixture.py")
+    assert _rules(vs) == {"JX010"}
+    assert vs[0].func == "Penalization.__call__"
+    assert "host->device upload" in vs[0].message
+    # the device->host direction fires too, scoped to JX010
+    host = src.replace("jnp.asarray(s.lambda_penal, s.dtype)",
+                       "np.asarray(ob.transVel)")
+    vs = _failing(host, "cup3d_tpu/models/fixture.py")
+    assert _rules(vs) == {"JX010"}
+    assert "device->host read" in vs[0].message
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "        return jnp.asarray(",
+        "        # jax-lint: allow(JX010, host fallback path: the mirror\n"
+        "        # is fresh by construction)\n"
+        "        return jnp.asarray(",
+    )
+    all_vs = L.lint_source(ok, "cup3d_tpu/models/fixture.py")
+    assert not L.failing(all_vs)
+    assert any(v.rule == "JX010" and "host fallback" in
+               (v.suppression_reason or "") for v in all_vs)
+
+
+def test_jx010_scoping_and_precision():
+    src = (
+        "import jax.numpy as jnp\n"
+        "class D:\n"
+        "    def advance(self, dt):\n"
+        "        return jnp.asarray(self.lam, self.dtype)\n"
+    )
+    # hot sim/ scope fires; io/ (outside the obstacle pipeline) and a
+    # cold function name do not
+    assert _rules(_failing(src)) == {"JX010"}
+    assert not _failing(src, "cup3d_tpu/io/fixture.py")
+    cold = src.replace("def advance", "def checkpoint_restore")
+    assert not _failing(cold)
+    # precision: a local value is not loop-carried state, and host
+    # metadata reads never cross the boundary
+    local = src.replace("jnp.asarray(self.lam, self.dtype)",
+                        "jnp.asarray(dt, self.dtype)")
+    assert not _failing(local)
+    meta = src.replace("jnp.asarray(self.lam, self.dtype)",
+                       "jnp.asarray(self.chi.shape)")
+    assert not _failing(meta)
+
+
+def test_jx010_sanctioned_transfer_is_the_annotation():
+    """A `with sanctioned_transfer(tag):` block is the shared designed-
+    transfer marker for JX010 exactly as for JX001."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "from cup3d_tpu.analysis.runtime import sanctioned_transfer\n"
+        "class D:\n"
+        "    def advance(self, dt):\n"
+        "        with sanctioned_transfer('scalar-upload'):\n"
+        "            return jnp.asarray(self.lam, self.dtype)\n"
+    )
+    vs = L.lint_source(src, HOT)
+    assert not L.failing(vs)
+    hit = [v for v in vs if v.rule == "JX010"]
+    assert hit and all("scalar-upload" in v.suppression_reason for v in hit)
+
+
 def test_wrapped_annotation_comment_blocks_parse():
     """A multi-line (wrapped) annotation applies to the next code line."""
     src = (
@@ -497,6 +572,9 @@ def test_uniform_step_compiles_once_and_runs_transfer_clean(tmp_path):
         # device-dt AMR runs under recovery sync once per snapshot
         # cadence (resilience/recovery.py; VALIDATION.md round 10)
         "resilience-snapshot",
+        # megaloop carry seeding: once per entry into scan mode, never
+        # per step (sim/simulation.py advance_megaloop; round 11)
+        "scan-carry-upload",
     }
 
 
