@@ -297,8 +297,13 @@ def _megaloop_split(sim, dispatches: int = 4):
         host = 0.0
         t0 = time.perf_counter()
         for _ in range(dispatches):
+            # jax-lint: allow(JX006, host_dispatch_s measures the HOST
+            # residue per dispatch — the unsynced window is the point;
+            # the enclosing wall window syncs via block_until_ready)
             t1 = time.perf_counter()
             sim.advance_megaloop()
+            # jax-lint: allow(JX006, dispatch-only read by design: this
+            # samples host time while the device runs asynchronously)
             host += time.perf_counter() - t1
         jax.block_until_ready(sync())
         wall_s = (time.perf_counter() - t0) / (dispatches * K)
@@ -516,49 +521,94 @@ def bench_fish_uniform(n_default: int = 128):
         **trace_gate,
         **recover_gate,
         "megaloop": mega,
-        "roofline": _lanes_roofline(A, M, rhs),
+        "roofline": _lanes_roofline(A, M, rhs, grid),
         "per_operator_mean_s": prof,
         "n": n,
     }
 
 
-def _lanes_roofline(A, M, rhs):
+def _lanes_roofline(A, M, rhs, grid=None):
     """DEVICE time of the uniform lane-resident BiCGSTAB iteration (fixed
     iteration counts, one scalar sync) and its roofline placement — the
     uniform twin of _amr_roofline.  Traffic/FLOP model per cell-iteration:
     2 Laplacians (~8 flop, ~4 HBM passes), 2 exact getZ tile solves
     (ops/tilesolve.py W-matmul: 512 MACs/cell on the MXU, 2 HBM passes
-    each), ~10 vector ops -> ~2100 flop, ~90 B HBM."""
-    import jax
+    each), ~10 vector ops -> ~2100 flop, ~90 B HBM.
 
+    Round 12: times the LEGACY composition (each sub-op round-trips HBM)
+    and the FUSED per-iteration driver (ops/fused_bicgstab.py) side by
+    side on the same system, each with its analytic bytes model
+    (bytes_model / legacy_bytes_model) next to the measured rate, plus
+    the regression gate fused <= legacy (TPU only — the jnp-twin fused
+    path on CPU measures dispatch, not HBM)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cup3d_tpu.ops import fused_bicgstab as fb
     from cup3d_tpu.ops import krylov as kry
+    from cup3d_tpu.ops import precision as prc
 
     cells = int(np.prod(rhs.shape))
 
-    def kfix(b, k):
-        return kry.bicgstab(A, b, M=M, tol_abs=0.0, tol_rel=0.0,
-                            maxiter=k)[0]
-
-    f5 = jax.jit(lambda b: kfix(b, 5))
-    f25 = jax.jit(lambda b: kfix(b, 25))
-
     def timed(f, n=4):
         r = f(rhs)
-        float(r.reshape(-1)[0])
+        float(jnp.asarray(r).reshape(-1)[0])
         t0 = time.perf_counter()
         r2 = rhs
         for _ in range(n):
             r2 = f(r2)
-        float(r2.reshape(-1)[0])
+        float(jnp.asarray(r2).reshape(-1)[0])
         return (time.perf_counter() - t0) / n
 
-    per_iter = max((timed(f25) - timed(f5)) / 20.0, 1e-9)
+    def per_iter_of(kfix):
+        f5 = jax.jit(lambda b: kfix(b, 5))
+        f25 = jax.jit(lambda b: kfix(b, 25))
+        return max((timed(f25) - timed(f5)) / 20.0, 1e-9)
+
+    def kfix_legacy(b, k):
+        return kry.bicgstab(A, b, M=M, tol_abs=0.0, tol_rel=0.0,
+                            maxiter=k)[0]
+
     gz_flops, gz_bytes = _getz_cost_model()
+    flops_per_cell = 26.0 + 2.0 * gz_flops
     # per cell-iteration: 2 Laplacians (~8 flop, ~4 passes) + 2 getZ +
-    # ~10 vector ops (~1 flop, 2 passes each)
-    return _roofline_dict(per_iter, cells,
-                          flops_per_cell=26.0 + 2.0 * gz_flops,
-                          bytes_per_cell=74.0 + 2.0 * gz_bytes)
+    # ~10 vector ops (~1 flop, 2 passes each) — the legacy analytic
+    # model kept bitwise-compatible with BENCH_r04/r05 for trendlines;
+    # legacy_bytes_model() is the same composition under the fused
+    # model's stricter read+write counting rules
+    legacy = _roofline_dict(per_iter_of(kfix_legacy), cells,
+                            flops_per_cell=flops_per_cell,
+                            bytes_per_cell=74.0 + 2.0 * gz_bytes)
+    legacy["bytes_model_per_cell"] = fb.legacy_bytes_model()
+    out = {**legacy, "legacy": legacy}
+
+    if grid is not None:
+        store = prc.krylov_dtype()
+        use_two = kry.use_coarse_correction()
+
+        def kfix_fused(b, k):
+            return fb.fused_bicgstab(
+                grid, b, tol_abs=0.0, tol_rel=0.0, maxiter=k,
+                store_dtype=store, two_level=use_two)[0]
+
+        try:
+            model = fb.bytes_model(store, two_level=use_two)
+            fused = _roofline_dict(per_iter_of(kfix_fused), cells,
+                                   flops_per_cell=flops_per_cell,
+                                   bytes_per_cell=model["total"])
+            fused["bytes_model_per_cell"] = model
+            fused["store_dtype"] = jnp.dtype(store).name
+            out["fused"] = fused
+            on_tpu = jax.default_backend() == "tpu"
+            out["gate_fused_le_legacy"] = (
+                bool(fused["bicgstab_iter_device_ms"]
+                     <= legacy["bicgstab_iter_device_ms"])
+                if on_tpu else "skipped (no TPU: fused twins measure "
+                               "dispatch, not HBM)"
+            )
+        except Exception as e:  # pragma: no cover - config-dependent
+            out["fused"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def _getz_cost_model():

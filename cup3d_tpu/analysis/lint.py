@@ -48,6 +48,13 @@ Rule summary (full rationale in ``analysis/rules.py``):
          ``sim/``, ``ops/``, ``stream/`` or ``models/`` — the residue
          the megaloop work removed (cache the mirror identity-keyed,
          derive it on device, or carry it in the scan state).
+- JX011  reduction (``jnp.sum``/``dot``/``vdot``/``matmul``/
+         ``tensordot``/``lax.dot``) over bfloat16-tainted operands in
+         ``cup3d_tpu/ops/`` without an explicit ``dtype=`` /
+         ``preferred_element_type=`` accumulator: the round-12 mixed-
+         precision policy (ops/precision.py) stores Krylov vectors in
+         bf16 but must ACCUMULATE in f32 — a storage-precision
+         reduction silently destroys the stopping test.
 """
 
 from __future__ import annotations
@@ -115,6 +122,20 @@ ASARRAY_NAMES = frozenset(
 HOST_METADATA_ATTRS = frozenset(
     {"size", "ndim", "shape", "dtype", "itemsize", "nbytes", "sharding"}
 )
+
+#: JX011 scope: the Krylov/kernel modules where the round-12 mixed-
+#: precision policy stores vectors in bf16 — the only place a
+#: storage-precision reduction can reach the stopping test
+JX011_MODULE_RE = re.compile(r"cup3d_tpu/ops/")
+
+#: reduction-position callables JX011 watches (the accumulator-dtype
+#: hazard lives where many elements fold into few)
+JX011_REDUCTIONS = frozenset(
+    {"sum", "dot", "vdot", "matmul", "tensordot", "einsum", "dot_general"}
+)
+
+#: keyword args that name an explicit (>= f32) accumulator
+JX011_ACCUM_KWARGS = frozenset({"dtype", "preferred_element_type"})
 
 
 def _is_host_metadata(expr: ast.AST) -> bool:
@@ -376,8 +397,12 @@ class FileLint:
                 HOT_FUNC_RE.match(func.name)
             ):
                 self._check_obstacle_staging(func, qualname)  # JX010
+            if JX011_MODULE_RE.search(self.path):
+                self._check_bf16_reduction(func, qualname)  # JX011
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
+        if JX011_MODULE_RE.search(self.path):
+            self._check_bf16_reduction(self.tree, "<module>")  # JX011
         return self.violations
 
     # -- plumbing ----------------------------------------------------------
@@ -810,6 +835,111 @@ class FileLint:
                 "obs metrics so the measurement reaches the registry "
                 "and the step trace",
             )
+
+    # -- JX011 -------------------------------------------------------------
+
+    def _dtype_aliases(self) -> Dict[str, str]:
+        """Module-level ``_F32 = jnp.float32``-style aliases, so the
+        idiomatic local dtype names resolve like the dotted originals."""
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                leaf = _dotted(node.value).rsplit(".", 1)[-1]
+                if leaf in ("bfloat16", "float32", "float64"):
+                    aliases[node.targets[0].id] = leaf
+        return aliases
+
+    def _dtype_leaf(self, node: ast.AST, aliases: Dict[str, str]) -> str:
+        """'bfloat16'/'float32'/... for a dtype expression ('' unknown)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        name = _dotted(node)
+        if not name:
+            return ""
+        if "." not in name:
+            return aliases.get(name, name)
+        return name.rsplit(".", 1)[-1]
+
+    def _cast_dtype(self, call: ast.Call, aliases: Dict[str, str]) -> str:
+        """The dtype a call casts/constructs to: ``x.astype(D)`` or a jnp
+        constructor/reduction with ``dtype=D`` ('' when neither)."""
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "astype" and call.args):
+            return self._dtype_leaf(call.args[0], aliases)
+        if _is_jnp_call(call):
+            for kw in call.keywords:
+                if kw.arg == "dtype":
+                    return self._dtype_leaf(kw.value, aliases)
+        return ""
+
+    def _check_bf16_reduction(self, func: ast.AST, qualname: str) -> None:
+        """Reductions over bf16-tainted operands without an explicit
+        accumulator dtype (JX011).  Precision-first: taint starts ONLY at
+        an explicit bfloat16 cast/construction (``.astype(jnp.bfloat16)``,
+        ``dtype=jnp.bfloat16``, module aliases included) and propagates
+        through assignments; an f32/f64 re-cast launders.  A reduction
+        call (jnp.sum/dot/vdot/...) whose operand is tainted and that
+        names no ``dtype=``/``preferred_element_type=`` accumulator
+        fires."""
+        if not hasattr(self, "_jx011_aliases"):
+            self._jx011_aliases = self._dtype_aliases()
+        aliases = self._jx011_aliases
+
+        def value_taint(value: ast.AST, tainted: Set[str]) -> bool:
+            top = value
+            if (isinstance(top, ast.Call)
+                    and self._cast_dtype(top, aliases)
+                    in ("float32", "float64")):
+                return False  # explicit up-cast launders
+            for n in ast.walk(value):
+                if (isinstance(n, ast.Call)
+                        and self._cast_dtype(n, aliases) == "bfloat16"):
+                    return True
+            return bool(tainted & _names_in(value))
+
+        tainted: Set[str] = set()
+        for stmt in _walk_shallow(func):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets, value = [stmt.target], stmt.value
+            if value is None:
+                continue
+            hit = value_taint(value, tainted)
+            for t in targets:
+                stack = [t]
+                while stack:
+                    leaf = stack.pop()
+                    if isinstance(leaf, ast.Name):
+                        (tainted.add if hit
+                         else tainted.discard)(leaf.id)
+                    elif isinstance(leaf, (ast.Tuple, ast.List)):
+                        stack.extend(leaf.elts)
+                    elif isinstance(leaf, ast.Starred):
+                        stack.append(leaf.value)
+
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            root = name.split(".", 1)[0].lstrip("_")
+            if (name.rsplit(".", 1)[-1] not in JX011_REDUCTIONS
+                    or "." not in name
+                    or root not in ("jnp", "jax", "lax", "np", "numpy")):
+                continue
+            if any(kw.arg in JX011_ACCUM_KWARGS for kw in node.keywords):
+                continue
+            if any(value_taint(a, tainted) for a in node.args):
+                self._emit(
+                    "JX011", node, qualname,
+                    f"{name}() over bfloat16 operands reduces in storage "
+                    "precision; name the f32 accumulator explicitly "
+                    "(dtype=/preferred_element_type=) or up-cast the "
+                    "operand first (ops/precision.py policy)",
+                )
 
     # -- JX009 -------------------------------------------------------------
 

@@ -141,6 +141,20 @@ RULES: Dict[str, Rule] = {
             "(sim.dtype); float64 is reserved for host-side mirrors and "
             "accumulations.",
         ),
+        Rule(
+            "JX011",
+            "bf16 reduction without an explicit f32 accumulator",
+            "jnp.sum/dot/vdot (or lax.dot) over bfloat16 operands without "
+            "an explicit dtype=/preferred_element_type= accumulator "
+            "reduces in storage precision on some backends: at 128^3 a "
+            "bf16-accumulated dot product of the Krylov residual loses "
+            "~8 of the ~11 significand bits the stopping test needs, so "
+            "the solver reports convergence it does not have.  The round-"
+            "12 mixed-precision policy (ops/precision.py) stores Krylov "
+            "vectors in bf16 but ACCUMULATES in f32 everywhere — any "
+            "reduction touching a bf16-cast value must name its f32 "
+            "accumulator explicitly.",
+        ),
     )
 }
 

@@ -233,6 +233,13 @@ def build_amr_poisson_solver(
     ``krylov.use_coarse_correction``) — the resilience escalation ladder
     drops to tile-only getZ per driver, not per process.
 
+    This AMR front-end runs the unfused composition regardless of
+    CUP3D_FUSED (the fused lanes kernels assume the uniform x-major tile
+    layout); it still inherits the round-12 precision hygiene — getZ
+    tile solves accumulate in >= f32 for any storage dtype
+    (ops/tilesolve.py, ops/precision.py) and the bicgstab breakdown
+    threshold lives in the accumulation dtype.
+
     ``mean_constraint`` mirrors the reference's bMeanConstraint
     (ComputeLHS, main.cpp:9273-9327):
 

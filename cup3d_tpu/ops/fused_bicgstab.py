@@ -1,0 +1,608 @@
+"""Fused Pallas BiCGSTAB iteration for the lane-resident Poisson solve.
+
+The legacy composition (krylov.bicgstab over make_laplacian_lanes +
+tilesolve getZ) issues each Krylov iteration as ~a dozen separate XLA
+ops, and every intermediate (p, y, v, s, z, t) round-trips HBM between
+them — BENCH_r05 measured the iteration at 3.6% MFU / 37% of HBM peak
+on fish128.  This module replaces the iteration body with five fused
+``pallas_call`` stages over the lane-major ``(bs, bs, bs, T)`` layout,
+each chaining what the legacy path split:
+
+- ``update``  p/rhat recurrence + breakdown select + coarse tile-sums
+- ``getz``    exact DST tile solve (+ the two-level coarse/face terms)
+- ``lap``     cross-tile Laplacian apply + the iteration's dot partials
+- ``axpy``    s = r - alpha v + coarse tile-sums
+- ``finish``  x/r updates + the residual/rho dot partials
+
+Global reductions never materialize a full-size temporary: each stage
+emits **per-tile (lane) partials** ``(1, 1, 1, T)`` reduced over the
+512 cells of its own tile, and a cheap follow-up ``jnp.sum`` (f32)
+combines them into the iteration scalars.  The only full-array data
+that crosses stages inside one iteration are the Krylov vectors
+themselves (one read + one write each) and the 6 cross-tile neighbor
+face planes (1/8 of a vector) assembled between the getz and lap
+stages — tile interiors never leave VMEM between the Laplacian, the
+preconditioner, and the axpys.
+
+Mixed precision (ops/precision.py): Krylov vectors may be stored bf16;
+every kernel loads to f32, accumulates dots / tile-solve matmuls /
+tile-sums in f32 (matmuls at ``Precision.HIGHEST`` — a default-precision
+bf16 preconditioner stalls the outer solve, ops/tilesolve.py), and
+rounds back to the storage dtype only at the final store.  Partials are
+computed on the *stored* (rounded) values so the reported residual norm
+is the norm of the vector the next iteration actually sees.
+
+Every stage has a pure-jnp twin (`*_math` helpers shared verbatim by
+the kernel bodies), which is both the CPU execution path and the
+reference the ``interpret=True`` parity tests check against
+(tests/test_fused_bicgstab.py; the ``block_cg_tiles_fast`` pattern).
+This supersedes ops/getz_pallas.py's standalone CG kernel on the fused
+path: the getZ tile solve now runs *inside* the iteration stages (the
+legacy module remains the CUP3D_GETZ=cg fallback and keeps the shared
+``TILE_T``/``use_pallas`` plumbing).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from cup3d_tpu.ops import precision
+from cup3d_tpu.ops.getz_pallas import TILE_T, use_pallas
+
+_HI = jax.lax.Precision.HIGHEST
+_F32 = jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# shared stage math: the kernel bodies and the jnp twins run THIS code
+# ---------------------------------------------------------------------------
+
+
+def _azc_from_aux(aux: jnp.ndarray, bs: int) -> jnp.ndarray:
+    """(8, T) coarse aux rows -> A zc in lanes layout.
+
+    zc is constant per tile, so A zc is nonzero only on the 6 tile-face
+    planes; aux rows 0..5 carry the per-face deltas
+    (lo0, hi0, lo1, hi1, lo2, hi2 — krylov.make_face_deltas), row 6 the
+    coarse values zc, row 7 padding.  Reconstruction is concatenation
+    (face, zeros, face) per axis — no scatter, so it lowers in Mosaic."""
+    T = aux.shape[-1]
+    total = None
+    for ax in range(3):
+        shp_face = [bs, bs, bs, T]
+        shp_face[ax] = 1
+        shp_mid = [bs, bs, bs, T]
+        shp_mid[ax] = bs - 2
+        lo = jnp.broadcast_to(aux[2 * ax], tuple(shp_face))
+        hi = jnp.broadcast_to(aux[2 * ax + 1], tuple(shp_face))
+        mid = jnp.zeros(tuple(shp_mid), aux.dtype)
+        part = jnp.concatenate([lo, mid, hi], axis=ax)
+        total = part if total is None else total + part
+    return total
+
+
+def _cellsum(a: jnp.ndarray) -> jnp.ndarray:
+    """Per-tile partial: reduce the 512 cells of each lane, keep lanes.
+    The (1,1,1,T) result is what the cheap follow-up combine sums —
+    identical per lane whether computed chunked (kernel grid) or whole
+    (twin), which is what makes the interpret parity tests tight."""
+    return jnp.sum(a.astype(_F32), axis=(0, 1, 2), keepdims=True)
+
+
+def _update_math(r, p, v, rhat, beta, omega, broke, store):
+    """p/rhat recurrence with the breakdown re-seed folded in."""
+    r32, p32, v32 = (a.astype(_F32) for a in (r, p, v))
+    # on rho breakdown the legacy path zeroes p/v and re-seeds rhat = r
+    # (krylov.bicgstab body); the explicit zeroing (not just beta = 0)
+    # keeps a non-finite p/v from leaking through 0 * inf
+    p_eff = jnp.where(broke > 0.5, 0.0, p32)
+    v_eff = jnp.where(broke > 0.5, 0.0, v32)
+    p_new = r32 + beta * (p_eff - omega * v_eff)
+    rhat_new = jnp.where(broke > 0.5, r32, rhat.astype(_F32))
+    p_st = p_new.astype(store)
+    rh_st = rhat_new.astype(store)
+    return p_st, rh_st, _cellsum(p_st)
+
+
+def _getz_math(w, aux, S3, lam, h2, bs, two_level, store):
+    """Exact-getZ preconditioner application on a lanes chunk:
+    y = zc + tilesolve(-h2 (w - A zc)) (two-level) or
+    y = tilesolve(-h2 w) (tile-only).  Matmuls are f32 HIGHEST like
+    ops/tilesolve.py — the quality floor for the outer iteration."""
+    w32 = w.astype(_F32)
+    if two_level:
+        azc = _azc_from_aux(aux, bs)
+        b = -h2 * (w32 - azc)
+    else:
+        b = -h2 * w32
+    T = b.shape[-1]
+    b2 = b.reshape(bs ** 3, T)
+    t = jnp.dot(S3, b2, precision=_HI, preferred_element_type=_F32)
+    t = t / lam  # (512, 1) eigenvalues broadcast over lanes
+    z2 = jnp.dot(S3, t, precision=_HI, preferred_element_type=_F32)
+    y = z2.reshape(b.shape)
+    if two_level:
+        y = y + aux[6]
+    return y.astype(store)
+
+
+def _lap_math(w, planes, a, inv_h2, store):
+    """Cross-tile Laplacian apply + the iteration's dot partials.
+
+    ``planes`` (6, bs, bs, T): cross-tile neighbor face planes
+    (krylov.make_lane_planes), so the apply is pure intra-chunk
+    slicing/concat.  Emits Aw plus per-tile partials of a . Aw and
+    Aw . Aw (the second is free — Aw is already in registers)."""
+    from cup3d_tpu.ops.stencils import laplacian_lanes_chunk
+
+    aw = laplacian_lanes_chunk(
+        w.astype(_F32), planes.astype(_F32), inv_h2
+    ).astype(store)
+    aw32 = aw.astype(_F32)
+    d_a = _cellsum(a.astype(_F32) * aw32)
+    d_self = _cellsum(aw32 * aw32)
+    return aw, d_a, d_self
+
+
+def _axpy_math(r, v, alpha, store):
+    s = (r.astype(_F32) - alpha * v.astype(_F32)).astype(store)
+    return s, _cellsum(s)
+
+
+def _finish_math(x, y, z, s, t, rhat, alpha, omega, store):
+    """x/r updates + the residual / next-rho partials.  x stays f32
+    (the policy's wide accumulator over the narrow stored directions)."""
+    y32, z32, s32, t32 = (a.astype(_F32) for a in (y, z, s, t))
+    x_new = x + alpha * y32 + omega * z32
+    r_st = (s32 - omega * t32).astype(store)
+    r32 = r_st.astype(_F32)
+    p_rr = _cellsum(r32 * r32)
+    p_rhr = _cellsum(rhat.astype(_F32) * r32)
+    return x_new, r_st, p_rr, p_rhr
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel bodies: load refs, run the shared math, store
+# ---------------------------------------------------------------------------
+
+
+def _k_update(r_ref, p_ref, v_ref, rhat_ref, sc_ref,
+              pn_ref, rh_ref, ts_ref):
+    beta, omega, broke = sc_ref[0, 0], sc_ref[0, 1], sc_ref[0, 2]
+    p_new, rhat_new, ts = _update_math(
+        r_ref[...], p_ref[...], v_ref[...], rhat_ref[...],
+        beta, omega, broke, pn_ref.dtype,
+    )
+    pn_ref[...] = p_new
+    rh_ref[...] = rhat_new
+    ts_ref[...] = ts
+
+
+def _k_getz_two(w_ref, S3_ref, lam_ref, aux_ref, y_ref, *, h2, bs):
+    y_ref[...] = _getz_math(w_ref[...], aux_ref[...], S3_ref[...],
+                            lam_ref[...], h2, bs, True, y_ref.dtype)
+
+
+def _k_getz_tile(w_ref, S3_ref, lam_ref, y_ref, *, h2, bs):
+    y_ref[...] = _getz_math(w_ref[...], None, S3_ref[...], lam_ref[...],
+                            h2, bs, False, y_ref.dtype)
+
+
+def _k_lap(w_ref, pl_ref, a_ref, aw_ref, da_ref, ds_ref, *, inv_h2):
+    aw, d_a, d_self = _lap_math(w_ref[...], pl_ref[...], a_ref[...],
+                                inv_h2, aw_ref.dtype)
+    aw_ref[...] = aw
+    da_ref[...] = d_a
+    ds_ref[...] = d_self
+
+
+def _k_axpy(r_ref, v_ref, sc_ref, s_ref, ts_ref):
+    s, ts = _axpy_math(r_ref[...], v_ref[...], sc_ref[0, 0], s_ref.dtype)
+    s_ref[...] = s
+    ts_ref[...] = ts
+
+
+def _k_finish(x_ref, y_ref, z_ref, s_ref, t_ref, rhat_ref, sc_ref,
+              xo_ref, ro_ref, prr_ref, prh_ref):
+    x_new, r_new, p_rr, p_rhr = _finish_math(
+        x_ref[...], y_ref[...], z_ref[...], s_ref[...], t_ref[...],
+        rhat_ref[...], sc_ref[0, 0], sc_ref[0, 1], ro_ref.dtype,
+    )
+    xo_ref[...] = x_new
+    ro_ref[...] = r_new
+    prr_ref[...] = p_rr
+    prh_ref[...] = p_rhr
+
+
+# ---------------------------------------------------------------------------
+# stage dispatch: pallas_call (native or interpret) or the jnp twin
+# ---------------------------------------------------------------------------
+
+
+class _Stages(NamedTuple):
+    """Static per-solve stage configuration (shapes, dtypes, dispatch)."""
+
+    bs: int
+    Tpad: int
+    C: int
+    store: object        # storage dtype for Krylov vectors
+    h2: float
+    inv_h2: float
+    kernels: bool        # run pallas_call (native TPU or interpret)
+    interpret: bool
+
+    def _specs(self):
+        from jax.experimental import pallas as pl
+
+        bs, C = self.bs, self.C
+        vec = pl.BlockSpec((bs, bs, bs, C), lambda i: (0, 0, 0, i))
+        part = pl.BlockSpec((1, 1, 1, C), lambda i: (0, 0, 0, i))
+        planes = pl.BlockSpec((6, bs, bs, C), lambda i: (0, 0, 0, i))
+        aux = pl.BlockSpec((8, C), lambda i: (0, i))
+        mat = pl.BlockSpec((bs ** 3, bs ** 3), lambda i: (0, 0))
+        lam = pl.BlockSpec((bs ** 3, 1), lambda i: (0, 0))
+        scal = pl.BlockSpec((1, 8), lambda i: (0, 0))
+        return vec, part, planes, aux, mat, lam, scal
+
+    @property
+    def grid(self):
+        return (self.Tpad // self.C,)
+
+    def _shape(self, kind):
+        bs, T = self.bs, self.Tpad
+        if kind == "vec":
+            return jax.ShapeDtypeStruct((bs, bs, bs, T), self.store)
+        if kind == "vec32":
+            return jax.ShapeDtypeStruct((bs, bs, bs, T), _F32)
+        return jax.ShapeDtypeStruct((1, 1, 1, T), _F32)
+
+    # -- stages -----------------------------------------------------------
+
+    def update(self, r, p, v, rhat, scal):
+        if not self.kernels:
+            beta, omega, broke = scal[0, 0], scal[0, 1], scal[0, 2]
+            return _update_math(r, p, v, rhat, beta, omega, broke,
+                                self.store)
+        from jax.experimental import pallas as pl
+
+        vec, part, _, _, _, _, scs = self._specs()
+        return pl.pallas_call(
+            _k_update,
+            grid=self.grid,
+            in_specs=[vec, vec, vec, vec, scs],
+            out_specs=[vec, vec, part],
+            out_shape=[self._shape("vec"), self._shape("vec"),
+                       self._shape("part")],
+            # donate the carried p/rhat buffers into their updates
+            input_output_aliases={1: 0, 3: 1},
+            interpret=self.interpret,
+        )(r, p, v, rhat, scal)
+
+    def getz(self, w, aux, S3, lam):
+        two = aux is not None
+        if not self.kernels:
+            return _getz_math(w, aux, S3, lam, self.h2, self.bs, two,
+                              self.store)
+        from jax.experimental import pallas as pl
+
+        vec, _, _, auxs, mat, lams, _ = self._specs()
+        if two:
+            return pl.pallas_call(
+                partial(_k_getz_two, h2=self.h2, bs=self.bs),
+                grid=self.grid,
+                in_specs=[vec, mat, lams, auxs],
+                out_specs=vec,
+                out_shape=self._shape("vec"),
+                interpret=self.interpret,
+            )(w, S3, lam, aux)
+        return pl.pallas_call(
+            partial(_k_getz_tile, h2=self.h2, bs=self.bs),
+            grid=self.grid,
+            in_specs=[vec, mat, lams],
+            out_specs=vec,
+            out_shape=self._shape("vec"),
+            interpret=self.interpret,
+        )(w, S3, lam)
+
+    def lap(self, w, planes, a):
+        if not self.kernels:
+            return _lap_math(w, planes, a, self.inv_h2, self.store)
+        from jax.experimental import pallas as pl
+
+        vec, part, pls, _, _, _, _ = self._specs()
+        return pl.pallas_call(
+            partial(_k_lap, inv_h2=self.inv_h2),
+            grid=self.grid,
+            in_specs=[vec, pls, vec],
+            out_specs=[vec, part, part],
+            out_shape=[self._shape("vec"), self._shape("part"),
+                       self._shape("part")],
+            interpret=self.interpret,
+        )(w, planes, a)
+
+    def axpy(self, r, v, scal):
+        if not self.kernels:
+            return _axpy_math(r, v, scal[0, 0], self.store)
+        from jax.experimental import pallas as pl
+
+        vec, part, _, _, _, _, scs = self._specs()
+        return pl.pallas_call(
+            _k_axpy,
+            grid=self.grid,
+            in_specs=[vec, vec, scs],
+            out_specs=[vec, part],
+            out_shape=[self._shape("vec"), self._shape("part")],
+            interpret=self.interpret,
+        )(r, v, scal)
+
+    def finish(self, x, y, z, s, t, rhat, scal):
+        if not self.kernels:
+            return _finish_math(x, y, z, s, t, rhat, scal[0, 0],
+                                scal[0, 1], self.store)
+        from jax.experimental import pallas as pl
+
+        vec, part, _, _, _, _, scs = self._specs()
+        return pl.pallas_call(
+            _k_finish,
+            grid=self.grid,
+            in_specs=[vec, vec, vec, vec, vec, vec, scs],
+            out_specs=[vec, vec, part, part],
+            out_shape=[self._shape("vec32"), self._shape("vec"),
+                       self._shape("part"), self._shape("part")],
+            # donate x into x_new and the s buffer into r_new
+            input_output_aliases={0: 0, 3: 1},
+            interpret=self.interpret,
+        )(x, y, z, s, t, rhat, scal)
+
+
+def _scalars(*vals):
+    """Pack iteration scalars into the (1, 8) f32 row the kernels read."""
+    row = jnp.zeros((8,), _F32)
+    row = row.at[: len(vals)].set(jnp.stack(
+        [jnp.asarray(v, _F32) for v in vals]))
+    return row.reshape(1, 8)
+
+
+def _combine(part: jnp.ndarray) -> jnp.ndarray:
+    """Per-tile partials -> global scalar (the cheap follow-up op)."""
+    return jnp.sum(part, dtype=_F32)
+
+
+# ---------------------------------------------------------------------------
+# the fused solver driver
+# ---------------------------------------------------------------------------
+
+
+class _FusedState(NamedTuple):
+    k: jnp.ndarray
+    x: jnp.ndarray        # f32 accumulator
+    r: jnp.ndarray        # storage dtype from here down
+    rhat: jnp.ndarray
+    p: jnp.ndarray
+    v: jnp.ndarray
+    rho: jnp.ndarray      # f32 scalars
+    alpha: jnp.ndarray
+    omega: jnp.ndarray
+    rnorm: jnp.ndarray
+    rho_dot: jnp.ndarray  # rhat . r, carried from the finish partials
+    x_best: jnp.ndarray
+    rnorm_best: jnp.ndarray
+
+
+def fused_bicgstab(
+    grid,
+    b: jnp.ndarray,
+    *,
+    tol_abs: float = 1e-6,
+    tol_rel: float = 1e-4,
+    maxiter: int = 1000,
+    rnorm_ref=None,
+    x0: Optional[jnp.ndarray] = None,
+    bs: int = 8,
+    two_level: bool = True,
+    store_dtype=None,
+    kernels: Optional[bool] = None,
+    interpret: bool = False,
+):
+    """Fused-iteration preconditioned BiCGSTAB on the lanes layout.
+
+    Same contract as ``krylov.bicgstab`` specialized to the production
+    pressure system: A = the grid's 7-point Laplacian, M = the exact
+    getZ tile solve (+ the exact Galerkin coarse level when
+    ``two_level``).  ``b`` is the mean-removed rhs in lanes layout
+    (f32); returns ``(x_best (f32 lanes), rnorm_best, iterations)``.
+
+    ``kernels=None`` auto-selects pallas on TPU (getz_pallas.use_pallas)
+    and the jnp twins elsewhere; ``interpret=True`` forces the kernels
+    through the Pallas interpreter for the CPU parity tests.
+    """
+    from cup3d_tpu.ops import krylov, tilesolve
+
+    store = precision.krylov_dtype() if store_dtype is None else store_dtype
+    if kernels is None:
+        kernels = use_pallas()
+    if interpret:
+        kernels = True
+
+    T = b.shape[-1]
+    C = min(TILE_T, T)
+    Tpad = -(-T // C) * C
+    h2 = float(grid.h * grid.h)
+    st = _Stages(bs=bs, Tpad=Tpad, C=C, store=store, h2=h2,
+                 inv_h2=1.0 / h2, kernels=kernels, interpret=interpret)
+
+    S3, lam3, _ = tilesolve._basis(bs, "float32")
+    lam = lam3.reshape(bs ** 3, 1)
+    planes_fn = krylov.make_lane_planes(grid, bs)
+    coarse_core = krylov._make_coarse_core(grid, bs) if two_level else None
+    deltas_fn = krylov.make_face_deltas(grid, bs) if two_level else None
+
+    def padT(a):
+        if a.shape[-1] == Tpad:
+            return a
+        pad = [(0, 0)] * (a.ndim - 1) + [(0, Tpad - a.shape[-1])]
+        return jnp.pad(a, pad)
+
+    def planes(w):
+        # rolls must see the REAL lane extent: build on [:T], re-pad.
+        # Padded lanes keep zero planes, so they stay exactly zero
+        # through every stage (their rhs/x0 are zero-padded).
+        return padT(planes_fn(w[..., :T]))
+
+    def coarse_aux(tsum):
+        rc = tsum[0, 0, 0, :T]
+        zc = coarse_core(rc)
+        aux = jnp.concatenate(
+            [deltas_fn(zc), zc[None, :], jnp.zeros((1, T), _F32)], axis=0
+        )
+        return padT(aux)
+
+    b32 = padT(b.astype(_F32))
+    x0_ = jnp.zeros_like(b32) if x0 is None else padT(x0.astype(_F32))
+    A_init = krylov.make_laplacian_lanes(grid, bs)
+    if x0 is None:
+        r0 = b32  # A(0) == 0 exactly; skip the apply
+    else:
+        r0 = b32 - padT(A_init(x0.astype(_F32)))
+    rr0 = krylov._dot(r0, r0)
+    rnorm0 = jnp.sqrt(rr0)
+    ref = rnorm0 if rnorm_ref is None else rnorm_ref
+    target = jnp.maximum(tol_abs, tol_rel * ref)
+    # eps in the ACCUMULATION dtype: 1e-30 underflows to 0 in bf16,
+    # which would silently disable the breakdown re-seed (JX005 audit)
+    eps = jnp.asarray(1e-30, _F32)
+    one = jnp.asarray(1.0, _F32)
+
+    r_st = r0.astype(store)
+    init = _FusedState(
+        k=jnp.asarray(0, jnp.int32),
+        x=x0_,
+        r=r_st,
+        rhat=r_st,
+        p=jnp.zeros_like(r_st),
+        v=jnp.zeros_like(r_st),
+        rho=one,
+        alpha=one,
+        omega=one,
+        rnorm=rnorm0,
+        rho_dot=rr0,
+        x_best=x0_,
+        rnorm_best=rnorm0,
+    )
+
+    def cond(s: _FusedState):
+        return jnp.logical_and(s.k < maxiter, s.rnorm > target)
+
+    def body(s: _FusedState):
+        safe = krylov._safe
+        rn2 = s.rnorm * s.rnorm
+        broke = jnp.abs(s.rho_dot) < eps * jnp.maximum(rn2, 1.0)
+        rho_new = jnp.where(broke, rn2, s.rho_dot)
+        beta = (rho_new / safe(s.rho)) * (s.alpha / safe(s.omega))
+        beta = jnp.where(broke, 0.0, beta)
+
+        p, rhat, ts_p = st.update(
+            s.r, s.p, s.v, s.rhat,
+            _scalars(beta, s.omega, broke.astype(_F32)),
+        )
+        aux_p = coarse_aux(ts_p) if two_level else None
+        y = st.getz(p, aux_p, S3, lam)
+        v, d_rhv, _ = st.lap(y, planes(y), rhat)
+        alpha = rho_new / safe(_combine(d_rhv))
+
+        svec, ts_s = st.axpy(s.r, v, _scalars(alpha))
+        aux_s = coarse_aux(ts_s) if two_level else None
+        z = st.getz(svec, aux_s, S3, lam)
+        t, d_ts, d_tt = st.lap(z, planes(z), svec)
+        omega = _combine(d_ts) / safe(_combine(d_tt))
+
+        x, r, p_rr, p_rhr = st.finish(s.x, y, z, svec, t, rhat,
+                                      _scalars(alpha, omega))
+        rnorm = jnp.sqrt(_combine(p_rr))
+        better = rnorm < s.rnorm_best
+        return _FusedState(
+            k=s.k + 1, x=x, r=r, rhat=rhat, p=p, v=v,
+            rho=rho_new, alpha=alpha, omega=omega, rnorm=rnorm,
+            rho_dot=_combine(p_rhr),
+            x_best=jnp.where(better, x, s.x_best),
+            rnorm_best=jnp.minimum(rnorm, s.rnorm_best),
+        )
+
+    out = jax.lax.while_loop(cond, body, init)
+    return out.x_best[..., :T], out.rnorm_best, out.k
+
+
+# ---------------------------------------------------------------------------
+# analytic traffic model + smoke test
+# ---------------------------------------------------------------------------
+
+
+def bytes_model(store_dtype=None, two_level: bool = True) -> dict:
+    """Analytic HBM bytes per cell per fused iteration (reads + writes),
+    by stage — the model bench.py reports next to the measured rate.
+
+    e = storage bytes/cell (4 f32, 2 bf16); x stays 4 B.  Face planes
+    count 6 * bs^2 / bs^3 = 0.75 e per pass.  Partials/aux are O(T) and
+    ignored."""
+    store = precision.krylov_dtype() if store_dtype is None else store_dtype
+    e = jnp.dtype(store).itemsize
+    per = {
+        # r, p, v, rhat in; p, rhat out
+        "update": 6 * e,
+        # 2x (w in, y out)
+        "getz": 2 * (2 * e),
+        # 2x (planes glue: read 6 faces, write planes array)
+        "planes": 2 * (2 * 0.75 * e),
+        # 2x (w + planes + partner in, Aw out)
+        "lap": 2 * ((2 + 0.75) * e + e),
+        # r, v in; s out
+        "axpy": 3 * e,
+        # y, z, s, t, rhat in + x f32 in; x f32 + r out
+        "finish": 5 * e + 4 + 4 + e,
+        # best-x select: x_new, x_best in, x_best out (f32)
+        "best_x": 12,
+    }
+    per["total"] = round(sum(per.values()), 2)
+    return per
+
+
+def legacy_bytes_model() -> float:
+    """The unfused composition's per-cell-iteration bytes under the same
+    counting rules: every intermediate round-trips HBM between ops.
+    2 Laplacians (r+w each), 2 getZ (r+w), ~10 vector ops (2 passes),
+    4 dots (1 read), all f32."""
+    return 2 * 8.0 + 2 * 8.0 + 10 * 8.0 + 4 * 4.0
+
+
+def selftest() -> None:
+    """Interpret-mode kernel smoke: a 16^3 Poisson solve through the
+    fused driver with interpret kernels must match the jnp-twin driver.
+    Wired into tools/lint.sh so CI exercises the kernels without a TPU."""
+    import numpy as np
+
+    from cup3d_tpu.grid.uniform import BC, UniformGrid
+    from cup3d_tpu.ops import krylov
+
+    n = 16
+    g = UniformGrid((n, n, n), (1.0,) * 3, (BC.periodic,) * 3)
+    rng = np.random.default_rng(0)
+    rhs = jnp.asarray(rng.standard_normal((n, n, n)), _F32)
+    bt = krylov.to_lanes(rhs - jnp.mean(rhs))
+    kw = dict(tol_abs=1e-6, tol_rel=1e-5, maxiter=40, two_level=True,
+              store_dtype=_F32)
+    x_twin, rn_twin, k_twin = fused_bicgstab(g, bt, kernels=False, **kw)
+    x_kern, rn_kern, k_kern = fused_bicgstab(g, bt, interpret=True, **kw)
+    assert int(k_twin) == int(k_kern), (int(k_twin), int(k_kern))
+    scale = float(jnp.max(jnp.abs(x_twin))) or 1.0
+    err = float(jnp.max(jnp.abs(x_twin - x_kern))) / scale
+    assert err < 1e-5, err
+    print(f"fused_bicgstab selftest: OK (iters={int(k_twin)}, "
+          f"interpret-vs-twin rel err {err:.2e})")
+
+
+if __name__ == "__main__":
+    selftest()

@@ -17,6 +17,14 @@ vectors.
 ``krylov.block_cg_tiles`` is the public entry and dispatches here on TPU
 (via ``use_pallas``); tests call ``block_cg_tiles_fast(interpret=True)``
 for bit-level parity with the jnp reference on CPU.
+
+Round 12: on the production hot path (exact getZ + mean-removal) the
+standalone preconditioner kernel is SUPERSEDED by the fused per-iteration
+stages of ops/fused_bicgstab.py, which run the tile solve inside the same
+kernel program as the Laplacian apply and the iteration's dot partials —
+the per-application HBM round-trip this kernel saved now disappears
+entirely.  This module remains the CUP3D_GETZ=cg fallback and the home of
+the shared ``TILE_T`` / ``use_pallas`` plumbing the fused path imports.
 """
 
 from __future__ import annotations

@@ -46,7 +46,10 @@ _HI = jax.lax.Precision.HIGHEST
 
 
 def _dot(a, b):
-    # accumulate in at least f32; keeps f64 accuracy for f64 solves
+    # accumulate in at least f32 (precision.accum_dtype): bf16-stored
+    # Krylov vectors still reduce in f32, and f64 solves stay f64.  The
+    # promote_types form cannot silently produce f64 from f32/bf16
+    # inputs (JX005 audit, round 12).
     acc = jnp.promote_types(a.dtype, jnp.float32)
     return jnp.sum(a * b, dtype=acc)
 
@@ -153,6 +156,52 @@ def make_laplacian_lanes(grid: UniformGrid, bs: int = 8) -> Callable:
         return out * inv_h2
 
     return apply
+
+
+def make_lane_planes(grid: UniformGrid, bs: int = 8) -> Callable:
+    """w (bs,bs,bs,T) -> (6,bs,bs,T) cross-tile neighbor face planes,
+    rows [lo0, hi0, lo1, hi1, lo2, hi2]: row 2*ax+1 holds the +1
+    neighbor of each tile's cells at local index bs-1 along ``ax``, row
+    2*ax the -1 neighbor of the cells at index 0 — exactly the boundary
+    planes make_laplacian_lanes's ``neighbor()`` concatenates in, with
+    the same lane-roll / periodic-wrap / zero-gradient-clamp selection.
+
+    Factored out so the fused iteration (ops/fused_bicgstab.py) can
+    pass the planes as a kernel input and keep the Laplacian apply
+    itself pure intra-chunk slicing — this boundary fetch touches
+    6*bs^2/bs^3 = 3/4 of a plane's bytes per tile and is the only part
+    of the apply with cross-lane data flow (on the sharded path it is
+    also the natural seam for the ring-DMA halo, parallel/ring.py)."""
+    from cup3d_tpu.grid.uniform import BC
+
+    nb = tuple(s // bs for s in grid.shape)
+    strides = (nb[1] * nb[2], nb[2], 1)
+    T = nb[0] * nb[1] * nb[2]
+    lanes = np.arange(T)
+    tco = (lanes // strides[0] % nb[0],
+           lanes // strides[1] % nb[1],
+           lanes % nb[2])
+
+    def planes(t: jnp.ndarray) -> jnp.ndarray:
+        rows = []
+        for ax in range(3):
+            periodic = grid.bc[ax] == BC.periodic
+            st, nba = strides[ax], nb[ax]
+            p0 = jax.lax.slice_in_dim(t, 0, 1, axis=ax)       # own low plane
+            p1 = jax.lax.slice_in_dim(t, bs - 1, bs, axis=ax)  # own high
+            hi = jnp.roll(p0, -st, axis=-1)  # next tile's low plane
+            hi = jnp.where(jnp.asarray(tco[ax] == nba - 1),
+                           jnp.roll(p0, (nba - 1) * st, axis=-1)
+                           if periodic else p1, hi)
+            lo = jnp.roll(p1, st, axis=-1)   # previous tile's high plane
+            lo = jnp.where(jnp.asarray(tco[ax] == 0),
+                           jnp.roll(p1, -(nba - 1) * st, axis=-1)
+                           if periodic else p0, lo)
+            rows.append(jnp.squeeze(lo, axis=ax))
+            rows.append(jnp.squeeze(hi, axis=ax))
+        return jnp.stack(rows, axis=0)
+
+    return planes
 
 
 # ---------------------------------------------------------------------------
@@ -342,9 +391,46 @@ def make_twolevel_preconditioner_lanes(grid: UniformGrid, h2: float,
     sublane planes and is assembled analytically from coarse neighbor
     differences — no fine-grid stencil application.
     """
+    coarse_vec = _make_coarse_solve_vec(grid, bs)
+    nb = tuple(s // bs for s in grid.shape)
+    T = nb[0] * nb[1] * nb[2]
+    deltas_fn = make_face_deltas(grid, bs)
+
+    def lap_tileconst(zc: jnp.ndarray) -> jnp.ndarray:
+        """(T,) coarse values -> A zc in lanes layout (bs,bs,bs,T)."""
+        d = deltas_fn(zc)
+        out = jnp.zeros((bs, bs, bs, T), zc.dtype)
+        for ax in range(3):
+            idx_hi = [slice(None)] * 4
+            idx_hi[ax] = bs - 1
+            idx_lo = [slice(None)] * 4
+            idx_lo[ax] = 0
+            out = out.at[tuple(idx_hi)].add(d[2 * ax + 1])
+            out = out.at[tuple(idx_lo)].add(d[2 * ax])
+        return out
+
+    def M(r: jnp.ndarray) -> jnp.ndarray:
+        zc = coarse_vec(r)
+        z = getz_lanes(-h2 * (r - lap_tileconst(zc)),
+                       cg_iters=precond_iters)
+        return z + zc[None, None, None, :]
+
+    return M
+
+
+def make_face_deltas(grid: UniformGrid, bs: int = 8) -> Callable:
+    """zc (T,) coarse tile values -> (6, T) face deltas of A zc, rows
+    [lo0, hi0, lo1, hi1, lo2, hi2].
+
+    For tile-constant zc, A zc is nonzero only on the 6 tile-face
+    planes: row 2*ax+1 is the value added on the face at local index
+    bs-1 along ``ax`` ((next - self)/h^2 with the BC's wrap/clamp), row
+    2*ax the face at index 0.  make_twolevel_preconditioner_lanes
+    scatters these into the lanes layout; the fused iteration
+    (ops/fused_bicgstab.py) ships them to its getZ kernel as coarse aux
+    rows and reconstructs A zc in-kernel by face concatenation."""
     from cup3d_tpu.grid.uniform import BC
 
-    coarse_vec = _make_coarse_solve_vec(grid, bs)
     nb = tuple(s // bs for s in grid.shape)
     strides = (nb[1] * nb[2], nb[2], 1)
     T = nb[0] * nb[1] * nb[2]
@@ -357,9 +443,8 @@ def make_twolevel_preconditioner_lanes(grid: UniformGrid, h2: float,
     masks_hi = [jnp.asarray(tco[ax] == nb[ax] - 1) for ax in range(3)]
     masks_lo = [jnp.asarray(tco[ax] == 0) for ax in range(3)]
 
-    def lap_tileconst(zc: jnp.ndarray) -> jnp.ndarray:
-        """(T,) coarse values -> A zc in lanes layout (bs,bs,bs,T)."""
-        out = jnp.zeros((bs, bs, bs, T), zc.dtype)
+    def deltas(zc: jnp.ndarray) -> jnp.ndarray:
+        rows = []
         for ax in range(3):
             st, nba = strides[ax], nb[ax]
             nxt = jnp.roll(zc, -st)
@@ -371,28 +456,29 @@ def make_twolevel_preconditioner_lanes(grid: UniformGrid, h2: float,
             wrap_lo = jnp.roll(zc, -(nba - 1) * st)
             prv = jnp.where(masks_lo[ax],
                             wrap_lo if periodic[ax] else zc, prv)
-            d_hi = (nxt - zc) * inv_h2
-            d_lo = (prv - zc) * inv_h2
-            idx_hi = [slice(None)] * 3 + [slice(None)]
-            idx_hi[ax] = bs - 1
-            idx_lo = [slice(None)] * 3 + [slice(None)]
-            idx_lo[ax] = 0
-            out = out.at[tuple(idx_hi)].add(d_hi)
-            out = out.at[tuple(idx_lo)].add(d_lo)
-        return out
+            rows.append((prv - zc) * inv_h2)
+            rows.append((nxt - zc) * inv_h2)
+        return jnp.stack(rows, axis=0)
 
-    def M(r: jnp.ndarray) -> jnp.ndarray:
-        zc = coarse_vec(r)
-        z = getz_lanes(-h2 * (r - lap_tileconst(zc)),
-                       cg_iters=precond_iters)
-        return z + zc[None, None, None, :]
-
-    return M
+    return deltas
 
 
 def _make_coarse_solve_vec(grid: UniformGrid, bs: int = 8) -> Callable:
     """(bs,bs,bs,T) residual -> (T,) coarse correction values (the shared
     core of make_coarse_correction_lanes / make_twolevel_preconditioner)."""
+    core = _make_coarse_core(grid, bs)
+
+    def solve_vec(rt: jnp.ndarray) -> jnp.ndarray:
+        return core(jnp.sum(rt, axis=(0, 1, 2)).reshape(-1))
+
+    return solve_vec
+
+
+def _make_coarse_core(grid: UniformGrid, bs: int = 8) -> Callable:
+    """(T,) tile sums (R = P^T r) -> (T,) coarse correction values: the
+    eigendecomposition einsum core of _make_coarse_solve_vec, split out
+    so the fused iteration can feed it the per-tile partial sums its
+    kernels already emit instead of re-reducing the fine grid."""
     from cup3d_tpu.grid.uniform import BC
 
     nb = tuple(s // bs for s in grid.shape)
@@ -431,8 +517,8 @@ def _make_coarse_solve_vec(grid: UniformGrid, bs: int = 8) -> Callable:
     inv3 = jnp.asarray(inv3.astype(dt))
     T = nb[0] * nb[1] * nb[2]
 
-    def solve_vec(rt: jnp.ndarray) -> jnp.ndarray:
-        rc = jnp.sum(rt, axis=(0, 1, 2)).reshape(nb)  # R = P^T (tile sum)
+    def core(rc_flat: jnp.ndarray) -> jnp.ndarray:
+        rc = rc_flat.reshape(nb)
         t = jnp.einsum("ia,abc->ibc", Vx.T, rc, precision=_HI)
         t = jnp.einsum("jb,ibc->ijc", Vy.T, t, precision=_HI)
         t = jnp.einsum("kc,ijc->ijk", Vz.T, t, precision=_HI)
@@ -442,7 +528,7 @@ def _make_coarse_solve_vec(grid: UniformGrid, bs: int = 8) -> Callable:
         zc = jnp.einsum("ck,abk->abc", Vz, t, precision=_HI)
         return zc.reshape(T)
 
-    return solve_vec
+    return core
 
 
 def use_coarse_correction() -> bool:
@@ -674,7 +760,10 @@ def bicgstab(
     if x0 is None:
         x0 = jnp.zeros_like(b)
 
-    eps = jnp.asarray(1e-30, b.dtype)
+    # breakdown threshold in the ACCUMULATION dtype, not b.dtype: 1e-30
+    # underflows to 0 in bf16/f16 storage, which would silently disable
+    # the rho re-seed below (round-12 mixed-precision audit)
+    eps = jnp.asarray(1e-30, jnp.promote_types(b.dtype, jnp.float32))
 
     r0 = b - apply_A(x0)
     rnorm0 = jnp.sqrt(_dot(r0, r0))
@@ -747,6 +836,9 @@ def bicgstab(
 
 
 def _safe(d):
+    # ``d`` is always an accumulated scalar (f32+, never bf16 — see
+    # _dot), so the 1e-30 floor is representable; the dtype-matched
+    # asarray cannot promote an f32 pipeline to f64 (JX005 audit).
     return jnp.where(jnp.abs(d) > 1e-30, d, jnp.asarray(1e-30, d.dtype))
 
 
@@ -824,6 +916,43 @@ def build_iterative_solver(
 
         def M(r):
             return getz_lanes(-h2 * r, cg_iters=precond_iters)
+
+    from cup3d_tpu.ops import precision as _precision
+
+    # round 12: loud build-time error for knob combinations that cannot
+    # honor a bf16 request (no silent downgrade)
+    _precision.check_policy(mean_constraint)
+    # The fused per-iteration driver covers the production hot path
+    # only: mean-removal constraint + exact getZ.  The pinned-row modes
+    # (1/3) and the legacy CG getZ keep the unfused composition at f32
+    # storage — they are off the hot path and the single-row A
+    # modification doesn't fit the fused stencil kernel.
+    if (_precision.use_fused() and mean_constraint == 2
+            and use_exact_getz()):
+        from cup3d_tpu.ops import fused_bicgstab as _fused
+
+        store = _precision.krylov_dtype()
+
+        def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
+                  with_stats: bool = False):
+            b = rhs - jnp.mean(rhs)
+            bt = to_lanes(b, precond_bs)
+            x0t = None if x0 is None else to_lanes(x0, precond_bs)
+            xt, rnorm, k = _fused.fused_bicgstab(
+                grid, bt, tol_abs=tol_abs, tol_rel=tol_rel,
+                maxiter=maxiter, rnorm_ref=jnp.sqrt(_dot(bt, bt)),
+                x0=x0t, bs=precond_bs, two_level=use_two,
+                store_dtype=store,
+            )
+            x = from_lanes(xt, rhs.shape)
+            x = x - jnp.mean(x)
+            if with_stats:
+                return x, solver_stats(rnorm, k)
+            return x
+
+        solve.supports_stats = True
+        solve.maxiter = maxiter
+        return solve
 
     def solve(rhs: jnp.ndarray, x0: Optional[jnp.ndarray] = None,
               with_stats: bool = False):

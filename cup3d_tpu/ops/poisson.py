@@ -104,7 +104,14 @@ def make_poisson_solver(grid: UniformGrid, kind: str = "spectral",
     iterative path; the spectral solve is mean-free by construction.
     ``two_level``/``maxiter`` parameterize the iterative path for the
     resilience escalation ladder (resilience/recovery.py); the spectral
-    solver is direct and ignores both."""
+    solver is direct and ignores both.
+
+    Round 12: the iterative path additionally honors the
+    CUP3D_KRYLOV_DTYPE / CUP3D_FUSED knobs (ops/precision.py) — bf16
+    Krylov storage routes through the fused per-iteration Pallas driver
+    (ops/fused_bicgstab.py) while keeping this factory's contract
+    (``with_stats``, ``maxiter``, the escalation ladder) unchanged; the
+    default f32 config stays bitwise-identical to the unfused solver."""
     if kind == "spectral":
         return build_spectral_solver(grid, dtype)
     if kind == "iterative":

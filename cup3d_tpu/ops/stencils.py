@@ -96,3 +96,25 @@ def curl(up, w, h):
 
 def vector_laplacian(up, w, h):
     return jnp.stack([laplacian(up[..., c], w, h) for c in range(3)], axis=-1)
+
+
+def laplacian_lanes_chunk(t: jnp.ndarray, planes: jnp.ndarray,
+                          inv_h2) -> jnp.ndarray:
+    """7-point Laplacian on a lane-resident chunk (bs, bs, bs, T) whose
+    cross-tile boundary values arrive as 6 explicit face planes
+    (6, bs, bs, T), rows [lo0, hi0, lo1, hi1, lo2, hi2]
+    (krylov.make_lane_planes).
+
+    With the boundary data externalized, the apply is pure intra-chunk
+    slice/concat arithmetic — the form that lowers both in an XLA fusion
+    and inside a Pallas kernel body over lane chunks, which is exactly
+    how the fused BiCGSTAB iteration uses it (ops/fused_bicgstab.py
+    shares this function between its kernel and its jnp twin)."""
+    out = -6.0 * t
+    out = out + jnp.concatenate([t[1:], planes[1][None]], axis=0)
+    out = out + jnp.concatenate([planes[0][None], t[:-1]], axis=0)
+    out = out + jnp.concatenate([t[:, 1:], planes[3][:, None]], axis=1)
+    out = out + jnp.concatenate([planes[2][:, None], t[:, :-1]], axis=1)
+    out = out + jnp.concatenate([t[:, :, 1:], planes[5][:, :, None]], axis=2)
+    out = out + jnp.concatenate([planes[4][:, :, None], t[:, :, :-1]], axis=2)
+    return out * inv_h2

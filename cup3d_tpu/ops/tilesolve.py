@@ -72,21 +72,26 @@ def tile_solve_blocks(b: jnp.ndarray, shift=None) -> jnp.ndarray:
     bs = b.shape[-1]
     lead = b.shape[:-3]
     n = int(np.prod(lead)) if lead else 1
-    S3, lam3, W = _basis(bs, b.dtype.name)
-    b2 = b.reshape(n, bs ** 3)
+    # basis + matmuls in the ACCUMULATION dtype (>= f32): a bf16 basis
+    # degrades the preconditioner enough to stall the outer BiCGSTAB
+    # (see module docstring) — sub-f32 inputs are solved in f32 and
+    # rounded on the way out (ops/precision.py policy, round 12)
+    acc = jnp.promote_types(b.dtype, jnp.float32)
+    S3, lam3, W = _basis(bs, jnp.dtype(acc).name)
+    b2 = b.reshape(n, bs ** 3).astype(acc)
     # always the split form: measured in-loop on the axon TPU, ONE
     # (n,512)x(512,512) HIGHEST matmul costs ~320us while the TWO split
     # matmuls cost ~23us total (validation/prof_xla_prims.py) — the
     # single-pass W form is never worth it
     if shift is None:
-        sh = jnp.zeros((n, 1), b.dtype)
+        sh = jnp.zeros((n, 1), acc)
     else:
-        sh = jnp.broadcast_to(jnp.asarray(shift, b.dtype),
+        sh = jnp.broadcast_to(jnp.asarray(shift, acc),
                               lead + (1, 1, 1)).reshape(n, 1)
     t = jax.lax.dot(b2, S3, precision=_HI)  # S3 symmetric: rows @ S3
     t = t / (lam3[None, :] + sh)
     z = jax.lax.dot(t, S3, precision=_HI)
-    return z.reshape(b.shape)
+    return z.reshape(b.shape).astype(b.dtype)
 
 
 def tile_solve_lanes(bt: jnp.ndarray, shift=None) -> jnp.ndarray:
@@ -97,14 +102,17 @@ def tile_solve_lanes(bt: jnp.ndarray, shift=None) -> jnp.ndarray:
     """
     bs = bt.shape[0]
     T = bt.shape[-1]
-    S3, lam3, W = _basis(bs, bt.dtype.name)
-    b2 = bt.reshape(bs ** 3, T)
+    # accumulate in >= f32 regardless of storage dtype (see
+    # tile_solve_blocks / ops/precision.py)
+    acc = jnp.promote_types(bt.dtype, jnp.float32)
+    S3, lam3, W = _basis(bs, jnp.dtype(acc).name)
+    b2 = bt.reshape(bs ** 3, T).astype(acc)
     # split form always — see tile_solve_blocks
     if shift is None:
-        sh = jnp.zeros((1, T), bt.dtype)
+        sh = jnp.zeros((1, T), acc)
     else:
-        sh = jnp.broadcast_to(jnp.asarray(shift, bt.dtype), (1, T))
+        sh = jnp.broadcast_to(jnp.asarray(shift, acc), (1, T))
     t = jax.lax.dot(S3, b2, precision=_HI)
     t = t / (lam3[:, None] + sh)
     z = jax.lax.dot(S3, t, precision=_HI)
-    return z.reshape(bt.shape)
+    return z.reshape(bt.shape).astype(bt.dtype)

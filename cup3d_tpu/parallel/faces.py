@@ -144,9 +144,18 @@ def _plan_exchange(
 
 def _exchange_entries(ext, send_idx, axis, region_off, M):
     """Send full entries (rows of ext) and write them into the recv
-    region starting at region_off.  ext: (n_local, C, bs, bs, bs)."""
+    region starting at region_off.  ext: (n_local, C, bs, bs, bs).
+
+    CUP3D_RING_HALO=1 swaps the blocking all_to_all for the ring-permute
+    transport (parallel/ring.py): same chunk routing, but on TPU each
+    shard-to-shard chunk is an async remote copy streaming over ICI."""
+    from cup3d_tpu.parallel import ring
+
     send = ext[send_idx]  # (D, M, C, bs, bs, bs)
-    recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)
+    if ring.use_ring_halo():
+        recv = ring.ring_all_to_all(send, axis)
+    else:
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)
     recv = recv.reshape((-1,) + ext.shape[1:])
     return jax.lax.dynamic_update_slice(
         ext, recv.astype(ext.dtype), (region_off, 0, 0, 0, 0)

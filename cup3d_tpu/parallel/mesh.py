@@ -23,27 +23,55 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _factor2(n: int) -> Tuple[int, int]:
-    """n -> (a, b), a*b = n, as square as possible, a >= b."""
-    b = int(np.floor(np.sqrt(n)))
-    while n % b:
-        b -= 1
-    return n // b, b
+def _factor2(n: int,
+             divide: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
+    """n -> (a, b), a*b = n, as square as possible, a >= b.
+
+    With ``divide`` = (nx, ny) block counts, only factorizations whose
+    axes evenly divide them qualify (either orientation; squarest
+    wins).  Device counts with no valid split raise — previously e.g. 6
+    devices over a 64-block axis silently produced a (3, 2) mesh whose
+    x axis cannot shard the grid at all, and every downstream sharding
+    constraint quietly replicated (round-12 non-power-of-two fix)."""
+    if n <= 0:
+        raise ValueError(f"cannot factor a mesh over {n} devices")
+    pairs = []
+    for b in range(int(np.floor(np.sqrt(n))), 0, -1):
+        if n % b == 0:
+            pairs.append((n // b, b))
+    if divide is None:
+        return pairs[0]
+    for a, b in pairs:
+        if divide[0] % a == 0 and divide[1] % b == 0:
+            return a, b
+        if divide[0] % b == 0 and divide[1] % a == 0:
+            return b, a
+    raise ValueError(
+        f"{n} devices admit no 2-D mesh whose axes divide the "
+        f"(x, y) block counts {divide}: factor pairs "
+        f"{pairs} all leave a ragged axis"
+    )
 
 
 def make_mesh(devices: Optional[Sequence] = None,
               shape: Optional[Tuple[int, int]] = None,
-              axis_names: Tuple[str, str] = ("x", "y")) -> Mesh:
+              axis_names: Tuple[str, str] = ("x", "y"),
+              divide: Optional[Tuple[int, int]] = None) -> Mesh:
     """2-D mesh over the given (default: all) devices.
 
     On real hardware the device order produced by jax.devices() follows the
     physical torus, so a near-square factorization keeps both mesh axes on
     ICI neighbors.
+
+    ``divide`` = (nx, ny) grid extents (cells or blocks) the mesh axes
+    must divide evenly; non-power-of-two device counts then get a valid
+    (possibly non-square) shape, or a loud error when none exists,
+    instead of a silently unshardable mesh.
     """
     if devices is None:
         devices = jax.devices()
     if shape is None:
-        shape = _factor2(len(devices))
+        shape = _factor2(len(devices), divide)
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, axis_names)
 

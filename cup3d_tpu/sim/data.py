@@ -43,6 +43,16 @@ class SimulationData:
             tol_rel=cfg.poissonTolRel,
             mean_constraint=cfg.bMeanConstraint,
         )
+        # round 12: record which Krylov path this run compiled (storage
+        # dtype + fused-iteration driver) so a bench/telemetry dump can
+        # tell the configurations apart without re-deriving env state
+        from cup3d_tpu.obs import metrics as obs_metrics
+        from cup3d_tpu.ops import precision as _precision
+
+        obs_metrics.gauge("poisson.krylov_bf16").set(
+            float(_precision.krylov_dtype() == jnp.bfloat16))
+        obs_metrics.gauge("poisson.fused_iteration").set(
+            float(_precision.use_fused()))
 
         # scalars (host side, mirroring main.cpp:15348-15387 defaults)
         self.time: float = 0.0

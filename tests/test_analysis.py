@@ -395,6 +395,70 @@ def test_jx010_sanctioned_transfer_is_the_annotation():
     assert hit and all("scalar-upload" in v.suppression_reason for v in hit)
 
 
+def test_jx011_bf16_reduction_fires_and_suppresses():
+    """A reduction over bf16-tainted operands with no explicit
+    accumulator dtype (the round-12 mixed-precision hazard)."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "def residual_norm(r):\n"
+        "    rb = r.astype(jnp.bfloat16)\n"
+        "    return jnp.sum(rb * rb)\n"
+    )
+    vs = _failing(src, "cup3d_tpu/ops/fixture.py")
+    assert _rules(vs) == {"JX011"}
+    assert vs[0].func == "residual_norm"
+    # module-level dtype aliases (_BF = jnp.bfloat16) taint too
+    alias = (
+        "import jax.numpy as jnp\n"
+        "_BF = jnp.bfloat16\n"
+        "def dot(a, b):\n"
+        "    return jnp.vdot(a.astype(_BF), b)\n"
+    )
+    assert _rules(_failing(alias, "cup3d_tpu/ops/fixture.py")) == {"JX011"}
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "    return jnp.sum(",
+        "    # jax-lint: allow(JX011, diagnostic dump, never feeds the\n"
+        "    # stopping test)\n"
+        "    return jnp.sum(",
+    )
+    all_vs = L.lint_source(ok, "cup3d_tpu/ops/fixture.py")
+    assert not L.failing(all_vs)
+    assert any(v.rule == "JX011" and "diagnostic dump" in
+               (v.suppression_reason or "") for v in all_vs)
+
+
+def test_jx011_explicit_accumulator_and_scope_are_clean():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def residual_norm(S, r):\n"
+        "    rb = r.astype(jnp.bfloat16)\n"
+        "    a = jnp.sum(rb * rb, dtype=jnp.float32)\n"
+        "    b = jnp.dot(S, rb, preferred_element_type=jnp.float32)\n"
+        "    r32 = rb.astype(jnp.float32)\n"
+        "    c = jnp.sum(r32 * r32)\n"
+        "    return a, b, c\n"
+    )
+    # named accumulator, and an f32 re-cast launders the taint
+    assert not _failing(src, "cup3d_tpu/ops/fixture.py")
+    # pure-f32 code never fires
+    f32 = (
+        "import jax.numpy as jnp\n"
+        "def residual_norm(r):\n"
+        "    return jnp.sum(r * r)\n"
+    )
+    assert not _failing(f32, "cup3d_tpu/ops/fixture.py")
+    # scope: only cup3d_tpu/ops/ carries the mixed-precision policy
+    bf_elsewhere = (
+        "import jax.numpy as jnp\n"
+        "def residual_norm(r):\n"
+        "    rb = r.astype(jnp.bfloat16)\n"
+        "    return jnp.sum(rb * rb)\n"
+    )
+    assert not _failing(bf_elsewhere, HOT)
+
+
 def test_wrapped_annotation_comment_blocks_parse():
     """A multi-line (wrapped) annotation applies to the next code line."""
     src = (

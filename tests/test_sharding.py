@@ -59,6 +59,96 @@ def test_sharded_step_matches_single_device():
     assert len(sh_vel.sharding.device_set) == 8
 
 
+def test_factor2_divide_constraint():
+    """Round-12 regression: non-power-of-two device counts must either
+    produce a mesh whose axes divide the block counts or raise — never
+    the old silently-unshardable (3, 2)-over-64-blocks mesh."""
+    from cup3d_tpu.parallel.mesh import _factor2
+
+    assert _factor2(8) == (4, 2)
+    assert _factor2(6) == (3, 2)
+    assert _factor2(1) == (1, 1)
+    # divide= picks whichever orientation evenly splits the block counts
+    assert _factor2(6, divide=(48, 64)) == (3, 2)
+    assert _factor2(6, divide=(64, 48)) == (2, 3)
+    assert make_mesh(jax.devices()[:6], divide=(64, 48)).shape == {
+        "x": 2,
+        "y": 3,
+    }
+    with pytest.raises(ValueError):
+        _factor2(6, divide=(64, 64))
+    with pytest.raises(ValueError):
+        _factor2(0)
+
+
+def test_ring_all_to_all_matches_lax():
+    """ring_all_to_all is a drop-in for the blocking all_to_all that
+    faces.py replaces under CUP3D_RING_HALO (on CPU the transport is
+    ppermute, same dataflow as the TPU async-remote-copy kernel)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from cup3d_tpu.parallel import ring
+    from cup3d_tpu.parallel.compat import shard_map
+
+    D = 8
+    assert len(jax.devices()) >= D, "conftest must provide 8 CPU devices"
+    mesh = Mesh(np.asarray(jax.devices()[:D]), ("x",))
+    # per shard the local send is (D, M): row d is the chunk bound for
+    # shard d, exactly the all_to_all(split_axis=0, concat_axis=0) shape
+    x = jnp.arange(D * D * 5, dtype=jnp.float32).reshape(D * D, 5)
+    spec = P("x", None)
+
+    ours = shard_map(
+        lambda s: ring.ring_all_to_all(s, "x"),
+        mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False,
+    )(x)
+    ref = shard_map(
+        lambda s: jax.lax.all_to_all(s, "x", split_axis=0, concat_axis=0),
+        mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False,
+    )(x)
+    np.testing.assert_array_equal(np.asarray(ours), np.asarray(ref))
+
+
+@pytest.mark.parametrize("bc", [BC.periodic, BC.wall])
+@pytest.mark.parametrize("nx", [64, 128])  # nx=64 -> one tile column/shard
+def test_sharded_lanes_laplacian_matches_unsharded(bc, nx):
+    from jax.sharding import Mesh
+
+    from cup3d_tpu.ops import krylov
+    from cup3d_tpu.parallel import ring
+
+    D = 8
+    assert len(jax.devices()) >= D, "conftest must provide 8 CPU devices"
+    grid = UniformGrid((nx, 16, 16), (nx / 64.0, 0.25, 0.25), (bc,) * 3)
+    mesh = Mesh(np.asarray(jax.devices()[:D]), ("x",))
+
+    rng = np.random.default_rng(7)
+    t = jnp.asarray(
+        krylov.to_lanes(
+            jnp.asarray(rng.standard_normal(grid.shape), jnp.float32)
+        )
+    )
+    ref = krylov.make_laplacian_lanes(grid)(t)
+    got = ring.make_laplacian_lanes_sharded(grid, mesh)(t)
+    # values scale with inv_h2 (~4e3 here): compare relatively — the two
+    # evaluation orders agree to f32 rounding (measured rel ~1e-7)
+    scale = float(jnp.max(jnp.abs(ref)))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-5 * scale, rtol=0
+    )
+
+
+def test_sharded_lanes_laplacian_rejects_ragged_slab():
+    from jax.sharding import Mesh
+
+    from cup3d_tpu.parallel import ring
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("x",))
+    grid = UniformGrid((32, 16, 16), (1.0, 0.5, 0.5), (BC.periodic,) * 3)
+    with pytest.raises(ValueError, match="x-slab"):
+        ring.make_laplacian_lanes_sharded(grid, mesh)
+
+
 @pytest.mark.slow
 def test_dryrun_multichip_entrypoint():
     import importlib.util, pathlib

@@ -1,7 +1,8 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX009
-# incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs and JX009
-# swallowed-exception rules)
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX011
+# incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
+# swallowed-exception and JX011 bf16-reduction-accumulator rules)
+# + the fused-BiCGSTAB interpret-mode kernel smoke
 # + the obs trace schema selftest (tools/trace_check.py) + bytecode
 # compile of the whole package.  Nonzero exit on any non-baselined lint
 # finding or any syntax error.  The shipped tree carries an EMPTY
@@ -31,6 +32,16 @@ python -m cup3d_tpu.analysis --rules JX007 $PATHS -q
 # new silent `except: pass` outside resilience/ fails CI identifiably
 echo "== python -m cup3d_tpu.analysis --rules JX009 $PATHS"
 python -m cup3d_tpu.analysis --rules JX009 $PATHS -q
+
+# the bf16-reduction accumulator rule on its own line (round 12): a
+# storage-precision reduction sneaking into ops/ fails CI identifiably
+echo "== python -m cup3d_tpu.analysis --rules JX011 cup3d_tpu/ops"
+python -m cup3d_tpu.analysis --rules JX011 cup3d_tpu/ops -q
+
+# fused-kernel smoke (round 12): the interpret-mode selftest exercises
+# every Pallas stage of the fused BiCGSTAB driver without a TPU
+echo "== python -m cup3d_tpu.ops.fused_bicgstab"
+JAX_PLATFORMS=cpu python -m cup3d_tpu.ops.fused_bicgstab
 
 # obs trace schema: producer -> validator round trip without a sim
 # (ISSUE 4 satellite; validates real traces with an argument instead)
