@@ -1156,12 +1156,39 @@ def main():
             d["n"] = print_n
         out[k] = d
     print(json.dumps(out))
+    # round-13 artifact fix: the COMPLETE summary goes to disk
+    # (bench_summary.json) and appends to the perf-history store
+    # (obs/history.py — BENCH_r05's 2000-char tail cut the full record
+    # mid-JSON, leaving the harness trajectory empty); perfwatch gates
+    # the trajectory from the store, never from the tail
+    artifact = _write_artifacts(out)
     # the LAST line is a compact single-line summary (headline metric +
     # per-config cells/s + gates + stream counters only): the driver keeps
     # a 2000-char tail, which the full record above overflows mid-JSON
     # (VERDICT r5 weak #8, `parsed: null`) — the tail now always ends in
     # one complete parseable object
-    print(json.dumps(_compact_summary(out)))
+    compact = _compact_summary(out)
+    compact["artifact"] = artifact
+    print(json.dumps(compact))
+
+
+def _write_artifacts(out: dict) -> dict:
+    """Write bench_summary.json + append to the bench-history store;
+    any disk failure is reported in the compact tail, never raised (the
+    bench numbers were already printed)."""
+    summary_path = os.environ.get("CUP3D_BENCH_OUT", "bench_summary.json")
+    try:
+        with open(summary_path, "w") as f:
+            json.dump(out, f, indent=1)
+        from cup3d_tpu.obs.history import HistoryStore
+
+        store = HistoryStore()
+        store.append(out)
+        return {"summary_file": summary_path,
+                "history_file": store.path,
+                "history_records": len(store.load())}
+    except Exception as e:
+        return {"artifact_error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def _compact_summary(out: dict) -> dict:

@@ -55,6 +55,11 @@ Rule summary (full rationale in ``analysis/rules.py``):
          precision policy (ops/precision.py) stores Krylov vectors in
          bf16 but must ACCUMULATE in f32 — a storage-precision
          reduction silently destroys the stopping test.
+- JX012  direct ``jax.profiler`` use (imports or dotted access) inside
+         the package but outside ``cup3d_tpu/obs/``: the profiler
+         session is process-global, so an ad-hoc capture collides with
+         obs profile windows and its trace bypasses the device-time
+         attribution parser — use obs.profile capture windows instead.
 """
 
 from __future__ import annotations
@@ -392,6 +397,7 @@ class FileLint:
                 )
             self._check_timing_windows(func, qualname)      # JX006
             self._check_manual_timing(func, qualname)       # JX008
+            self._check_profiler_usage(func, qualname)      # JX012
             self._check_swallowed_exceptions(func, qualname)  # JX009
             if JX010_MODULE_RE.search(self.path) and bool(
                 HOT_FUNC_RE.match(func.name)
@@ -401,6 +407,7 @@ class FileLint:
                 self._check_bf16_reduction(func, qualname)  # JX011
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
+        self._check_profiler_usage(self.tree, "<module>")   # JX012
         if JX011_MODULE_RE.search(self.path):
             self._check_bf16_reduction(self.tree, "<module>")  # JX011
         return self.violations
@@ -834,6 +841,50 @@ class FileLint:
                 "spans (obs.trace.SpanTimer / the driver profiler) or "
                 "obs metrics so the measurement reaches the registry "
                 "and the step trace",
+            )
+
+    # -- JX012 -------------------------------------------------------------
+
+    def _check_profiler_usage(self, func: ast.AST, qualname: str) -> None:
+        """Direct ``jax.profiler`` access — ``import``/``from`` imports
+        or dotted ``jax.profiler.*`` chains — inside the package but
+        outside the obs layer: a second, uncoordinated profiling channel
+        (the profiler session is process-global).  Mirrors the JX008
+        pattern: one finding per function/module (the first hit in
+        source order, so one annotation covers a capture block); the obs
+        layer owns the profiler and is exempt by path, and so are
+        bench.py/validation harnesses (outside the package)."""
+        if not self.path.startswith("cup3d_tpu/"):
+            return
+        if self.path.startswith("cup3d_tpu/obs/"):
+            return
+        first = None
+        for node in _walk_shallow(func):
+            hit = False
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                hit = (mod == "jax.profiler"
+                       or mod.startswith("jax.profiler."))
+            elif isinstance(node, ast.Import):
+                hit = any(
+                    a.name == "jax.profiler"
+                    or a.name.startswith("jax.profiler.")
+                    for a in node.names
+                )
+            elif isinstance(node, ast.Attribute):
+                name = _dotted(node)
+                hit = (name == "jax.profiler"
+                       or name.startswith("jax.profiler."))
+            if hit and (first is None or node.lineno < first.lineno):
+                first = node
+        if first is not None:
+            self._emit(
+                "JX012", first, qualname,
+                "direct jax.profiler use outside cup3d_tpu/obs/: use obs "
+                "profile windows (obs.profile.CONTROLLER / "
+                "CaptureController.capture()) and obs spans "
+                "(CUP3D_TRACE_XLA=1) so captures coordinate and land on "
+                "the merged host+device timeline",
             )
 
     # -- JX011 -------------------------------------------------------------

@@ -155,6 +155,20 @@ RULES: Dict[str, Rule] = {
             "reduction touching a bf16-cast value must name its f32 "
             "accumulator explicitly.",
         ),
+        Rule(
+            "JX012",
+            "direct jax.profiler use outside the obs layer",
+            "jax.profiler.start_trace/stop_trace/TraceAnnotation called "
+            "outside cup3d_tpu/obs/ opens a second, uncoordinated "
+            "profiling channel: the profiler session is process-global, "
+            "so an ad-hoc capture colliding with an obs window aborts "
+            "one of them; ad-hoc annotations bypass the sink's cached "
+            "class and fast no-op path; and the resulting trace never "
+            "reaches the device-time attribution parser or the merged "
+            "host+device timeline.  Use obs profile windows "
+            "(obs.profile.CONTROLLER / CaptureController.capture()) and "
+            "obs spans under CUP3D_TRACE_XLA=1 instead.",
+        ),
     )
 }
 

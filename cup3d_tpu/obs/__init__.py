@@ -16,8 +16,30 @@ recorder — the repo's cross-cutting nervous system.
   NaN/Inf velocity, dt collapse, or a Poisson solve at its iteration
   cap.
 
-See README "Observability" for the metric catalog and trace schema, and
-VALIDATION.md round 9 for the pinned contract.
+Observability v2 (ISSUE 9) — the device half:
+
+- :mod:`cup3d_tpu.obs.profile` — programmatic ``jax.profiler`` capture
+  windows (``CUP3D_PROFILE=every:N``) + the trace-event parser that
+  attributes device-stream op time to logical sections (fused BiCGSTAB
+  stages, ring halos, megaloop body) and merges it into the step-trace
+  JSONL and Perfetto export.
+- :mod:`cup3d_tpu.obs.export` — zero-dependency background HTTP
+  exporter: ``/metrics`` (Prometheus text from the registry snapshot)
+  and ``/health`` (flight-recorder arm state, last-known-good step,
+  recovery counters).  ``CUP3D_METRICS_PORT`` enables.
+- :mod:`cup3d_tpu.obs.history` — append-only JSONL bench-history store
+  with rolling-median regression detection (``tools/perfwatch.py``).
+
+See README "Observability" / "Observability v2" for the metric catalog
+and trace schema, and VALIDATION.md rounds 9 and 13 for the pinned
+contracts.
 """
 
-from cup3d_tpu.obs import flight, metrics, trace  # noqa: F401
+from cup3d_tpu.obs import (  # noqa: F401
+    export,
+    flight,
+    history,
+    metrics,
+    profile,
+    trace,
+)

@@ -1,0 +1,259 @@
+"""Zero-dependency HTTP exporter: ``/metrics`` (Prometheus text) +
+``/health`` (JSON) — the scrape surface ROADMAP item 1's fleet server
+presupposes (ISSUE 9).
+
+Off by default.  ``CUP3D_METRICS_PORT=<port>`` (or an explicit
+:func:`ensure_exporter` call) starts one background
+``ThreadingHTTPServer`` daemon thread per process; the step loop is
+never touched — a scrape renders a registry :func:`snapshot` on the
+server thread, and the registry's own lock is the only shared state.
+
+``/metrics`` renders the flat ``obs/metrics.py`` snapshot keys
+(``name{k=v,...}[.suffix]``) into Prometheus exposition format 0.0.4:
+``cup3d_`` prefix, dots -> underscores, labels quoted/escaped, one
+``# TYPE`` line per family (untyped: the flat snapshot does not carry
+metric kinds).  :func:`parse_prometheus_text` is the matching parser —
+the round-trip is a tested contract, not a formatting accident.
+
+``/health`` reports what a supervisor needs before scraping history:
+per-flight-recorder arm state + last-known-good step (the weakref
+registry in ``obs/flight.py``), recovery/flight counters, trace sink
+and capture-window state.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from cup3d_tpu.obs import flight as _flight
+from cup3d_tpu.obs import metrics as _metrics
+from cup3d_tpu.obs import trace as _trace
+
+_NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$"
+)
+
+
+# -- Prometheus text rendering ----------------------------------------------
+
+
+def prometheus_key(flat: str) -> Tuple[str, Dict[str, str]]:
+    """One flat snapshot key -> (metric name, labels).
+
+    ``poisson.iters_hist{driver=amr}.count`` ->
+    (``cup3d_poisson_iters_hist_count``, {"driver": "amr"}).
+    """
+    labels: Dict[str, str] = {}
+    base = flat
+    if "{" in flat:
+        head, rest = flat.split("{", 1)
+        inner, _, tail = rest.partition("}")
+        labels = dict(p.split("=", 1) for p in inner.split(",") if "=" in p)
+        base = head + tail
+    name = "cup3d_" + _NAME_SANITIZE_RE.sub("_", base.strip("."))
+    return name, labels
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _unescape_label(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def _fmt_value(v: float) -> str:
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v)
+
+
+def render_prometheus(snap: Optional[Dict[str, float]] = None) -> str:
+    """The registry snapshot as Prometheus exposition text 0.0.4."""
+    snap = _metrics.snapshot() if snap is None else snap
+    families: Dict[str, list] = {}
+    for flat in sorted(snap):
+        name, labels = prometheus_key(flat)
+        families.setdefault(name, []).append((labels, snap[flat]))
+    lines = []
+    for name, series in families.items():
+        lines.append(f"# TYPE {name} untyped")
+        for labels, val in series:
+            lstr = ""
+            if labels:
+                inner = ",".join(
+                    f'{k}="{_escape_label(str(v))}"'
+                    for k, v in sorted(labels.items())
+                )
+                lstr = "{" + inner + "}"
+            lines.append(f"{name}{lstr} {_fmt_value(val)}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_text(text: str) -> Dict[Tuple[str, frozenset], float]:
+    """Exposition text -> {(name, frozenset(label items)): value}.
+    Raises ValueError on a malformed sample line (the round-trip test's
+    teeth); comment/blank lines are skipped per the format."""
+    out: Dict[Tuple[str, frozenset], float] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {i}: not a prometheus sample: {line!r}")
+        name, inner, val = m.group(1), m.group(2), m.group(3)
+        labels = frozenset(
+            (k, _unescape_label(v))
+            for k, v in _LABEL_RE.findall(inner or "")
+        )
+        out[(name, labels)] = float(val)
+    return out
+
+
+# -- /health ----------------------------------------------------------------
+
+
+def health_payload() -> dict:
+    """Supervisor view: flight-recorder arm state + last-known-good
+    step per live recorder, recovery counters, trace/profile state."""
+    from cup3d_tpu.obs import profile as _profile
+
+    snap = _metrics.snapshot()
+    flights = []
+    for fr in _flight.live_recorders():
+        flights.append({
+            "directory": fr.directory,
+            "armed": fr.armed,
+            "last_known_good_step": fr.last_known_good_step,
+            "steps_recorded": len(fr.steps),
+            "dumps_written": list(fr.dumps_written),
+            "recovery_events": len(fr.recovery_events),
+        })
+    counters = {k: v for k, v in snap.items()
+                if k.startswith(("flight.", "resilience.", "recovery."))}
+    return {
+        "status": "ok",
+        "time": time.time(),
+        "flight_recorders": flights,
+        "recovery_counters": counters,
+        "trace": {"enabled": _trace.TRACE.enabled,
+                  "steps_recorded": _trace.TRACE.steps_recorded,
+                  "steps_dropped": _trace.TRACE.steps_dropped},
+        "profile": {"windows": _profile.CONTROLLER.windows,
+                    "capturing": _profile.CONTROLLER.capturing},
+    }
+
+
+# -- the server --------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/health":
+                body = json.dumps(health_payload()).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404, "try /metrics or /health")
+                return
+        except Exception:
+            _metrics.counter("export.errors").inc()
+            self.send_error(500, "exporter render failed")
+            return
+        _metrics.counter("export.scrapes", path=path.strip("/")).inc()
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsExporter:
+    """One background daemon HTTP server; ``port=0`` binds an ephemeral
+    port (tests).  ``start()`` returns self; ``stop()`` is idempotent."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self.host = host
+        self.port = int(port)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsExporter":
+        if self._server is not None:
+            return self
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="cup3d-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+        _metrics.gauge("export.port").set(float(self.port))
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+#: the process singleton (env-gated); drivers call ensure_exporter() at
+#: construction — a no-op unless CUP3D_METRICS_PORT is set.
+EXPORTER: Optional[MetricsExporter] = None
+
+
+def ensure_exporter(port: Optional[int] = None) -> Optional[MetricsExporter]:
+    """Start (once) the process exporter.  ``port=None`` reads
+    ``CUP3D_METRICS_PORT``; unset/empty/0 means off.  Failure to bind is
+    counted, not raised — telemetry must never kill a run."""
+    global EXPORTER
+    if EXPORTER is not None:
+        return EXPORTER
+    if port is None:
+        spec = os.environ.get("CUP3D_METRICS_PORT", "")
+        if not spec or spec == "0":
+            return None
+        try:
+            port = int(spec)
+        except ValueError:
+            _metrics.counter("export.bad_port").inc()
+            return None
+    try:
+        EXPORTER = MetricsExporter(port=port).start()
+    except Exception:
+        _metrics.counter("export.bind_errors").inc()
+        return None
+    import atexit
+
+    atexit.register(EXPORTER.stop)
+    return EXPORTER

@@ -36,12 +36,23 @@ import json
 import math
 import os
 import time
+import weakref
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from cup3d_tpu.obs import metrics as _metrics
 
 SCHEMA_VERSION = 1
+
+#: every live recorder, held by weakref — the /health endpoint
+#: (obs/export.py) enumerates arm state / last-known-good from here
+#: without the drivers knowing the exporter exists
+_LIVE: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+
+
+def live_recorders() -> List["FlightRecorder"]:
+    """Currently-alive recorders (arbitrary order)."""
+    return list(_LIVE)
 
 #: step-record keys whose non-finiteness marks the step as BAD for the
 #: last-known-good bookkeeping
@@ -118,6 +129,12 @@ class FlightRecorder:
         # of rollback/retry events rides in any LATER postmortem.
         self.recovery_intercept: Optional[Callable[[str, dict], bool]] = None
         self.recovery_events: deque = deque(maxlen=64)
+        _LIVE.add(self)
+
+    @property
+    def armed(self) -> bool:
+        """True while the postmortem dump budget is unspent."""
+        return len(self.dumps_written) < self.max_dumps
 
     def note_recovery(self, event: dict) -> None:
         """Append one rollback/retry/give-up event (engine bookkeeping;

@@ -1,0 +1,174 @@
+"""Append-only bench-history store + rolling-baseline regression
+detection (ISSUE 9).
+
+``bench.py`` appends its COMPLETE summary (the untruncated object the
+2000-char driver tail cuts mid-JSON — BENCH_r05's artifact) to a JSONL
+store after every run; ``tools/perfwatch.py`` prints/gates the
+trajectory.  One line per run::
+
+    {"schema": 1, "ts": <unix>, "summary": {...the full bench out...}}
+
+Regression detection is deliberately simple and robust: per tracked
+metric, compare the newest value against the MEDIAN of the previous
+``window`` values — the median ignores one bad tunnel day, and a
+relative tolerance per metric direction separates drift from noise
+(the tested bar: a 20% slowdown fires, ±2-3% run noise stays quiet).
+
+The default metric set is the round-13 contract: ``cells_per_s``
+(headline, higher is better), ``bicgstab_iter_device_ms`` (fused-solver
+roofline, lower), ``wall_per_step_p95_s`` (tail latency, lower).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from statistics import median
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cup3d_tpu.obs import metrics as _metrics
+
+STORE_SCHEMA = 1
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """One tracked metric: ``paths`` are dotted lookups into the bench
+    summary, first hit wins (the fish block moves under ``detail`` on
+    single-config runs)."""
+
+    name: str
+    paths: Tuple[Tuple[str, ...], ...]
+    higher_is_better: bool = True
+    rel_tol: float = 0.10
+
+
+DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
+    MetricSpec("cells_per_s", (("value",),), higher_is_better=True),
+    MetricSpec(
+        "bicgstab_iter_device_ms",
+        (("fish", "roofline", "bicgstab_iter_device_ms"),
+         ("detail", "roofline", "bicgstab_iter_device_ms")),
+        higher_is_better=False,
+    ),
+    MetricSpec(
+        "wall_per_step_p95_s",
+        (("fish", "wall_per_step_p95_s"),
+         ("detail", "wall_per_step_p95_s")),
+        higher_is_better=False,
+    ),
+)
+
+
+def default_path() -> str:
+    """``CUP3D_BENCH_HISTORY`` or the validation-results store."""
+    return (os.environ.get("CUP3D_BENCH_HISTORY")
+            or os.path.join("validation", "results",
+                            "bench_history.jsonl"))
+
+
+def extract(summary: dict, spec: MetricSpec) -> Optional[float]:
+    """The spec's value out of one bench summary (None when absent or
+    non-numeric — a config that errored simply contributes no point)."""
+    for path in spec.paths:
+        node = summary
+        for key in path:
+            if not isinstance(node, dict) or key not in node:
+                node = None
+                break
+            node = node[key]
+        if isinstance(node, (int, float)) and not isinstance(node, bool):
+            return float(node)
+    return None
+
+
+class HistoryStore:
+    """Append-only JSONL store of bench summaries."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_path()
+
+    def append(self, summary: dict, ts: Optional[float] = None) -> dict:
+        wrapper = {"schema": STORE_SCHEMA,
+                   "ts": time.time() if ts is None else float(ts),
+                   "summary": summary}
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(wrapper) + "\n")
+        _metrics.counter("history.appends").inc()
+        return wrapper
+
+    def load(self) -> List[dict]:
+        """Every parseable wrapper, oldest first; unparseable lines are
+        counted (``history.bad_lines``) and skipped — one truncated
+        write must not orphan the whole trajectory."""
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    _metrics.counter("history.bad_lines").inc()
+                    continue
+                if isinstance(rec, dict) and isinstance(
+                        rec.get("summary"), dict):
+                    out.append(rec)
+                else:
+                    _metrics.counter("history.bad_lines").inc()
+        return out
+
+    def summaries(self) -> List[dict]:
+        return [r["summary"] for r in self.load()]
+
+
+def detect_regressions(summaries: Sequence[dict],
+                       specs: Sequence[MetricSpec] = DEFAULT_SPECS,
+                       window: int = 5) -> List[dict]:
+    """Newest summary vs the median of the previous ``window`` values,
+    per spec.  Returns one report dict per spec:
+
+        {"metric", "n", "current", "baseline", "ratio", "regressed",
+         "higher_is_better", "rel_tol"}         # or
+        {"metric", "n", "regressed": False, "reason": ...}
+
+    A metric regresses when the current/baseline ratio crosses the
+    spec's relative tolerance AGAINST its direction."""
+    reports = []
+    for spec in specs:
+        series = [v for v in (extract(s, spec) for s in summaries)
+                  if v is not None]
+        if len(series) < 2:
+            reports.append({"metric": spec.name, "n": len(series),
+                            "regressed": False,
+                            "reason": "insufficient history (<2 points)"})
+            continue
+        current = series[-1]
+        baseline = median(series[-(window + 1):-1])
+        if baseline == 0:
+            reports.append({"metric": spec.name, "n": len(series),
+                            "regressed": False,
+                            "reason": "zero baseline"})
+            continue
+        ratio = current / baseline
+        if spec.higher_is_better:
+            regressed = ratio < 1.0 - spec.rel_tol
+        else:
+            regressed = ratio > 1.0 + spec.rel_tol
+        reports.append({
+            "metric": spec.name, "n": len(series),
+            "current": current, "baseline": baseline,
+            "ratio": round(ratio, 4), "regressed": regressed,
+            "higher_is_better": spec.higher_is_better,
+            "rel_tol": spec.rel_tol,
+        })
+    return reports
+
+
+def any_regressed(reports: Sequence[dict]) -> bool:
+    return any(r.get("regressed") for r in reports)

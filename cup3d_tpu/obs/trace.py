@@ -31,15 +31,24 @@ Three layers, cheapest first:
    the async QoI pack — NO extra device sync), and feeds the flight
    recorder's ring buffer every step whether or not tracing is on.
 
-Trace record schema (``SCHEMA_VERSION``, pinned in VALIDATION.md round
-9; ``tools/trace_check.py`` validates files against it):
+Trace record schema (``SCHEMA_VERSION``, pinned in VALIDATION.md rounds
+9 and 13; ``tools/trace_check.py`` validates files against it):
 
-    {"schema": 1, "step": int, "t": float, "dt": float,
+    {"schema": 2, "step": int, "t": float, "dt": float,
      "wall_s": float,                     # host wall of the advance
      "solver": {"iters": float, "resid": float, "at_step": int}?,
      "stream_wait_s": float?,             # stall delta over the step
      "sections": {name: self_seconds}?,   # only when tracing is on
      ...driver extras (nb, bucket_capacity, regrid, umax)}
+
+Schema v2 (round 13) additionally admits kind-tagged AUXILIARY records
+interleaved with the step stream — ``obs/profile.py`` appends one per
+closed capture window with the device-time attribution:
+
+    {"schema": 2, "kind": "device", "step": int,   # window-end step
+     "window": [first_step, end_step],
+     "total_device_ms": float,
+     "device_sections": {section: ms}, "other_ms": float, "source": str}
 
 The metrics hot path guarantee: nothing in this module reads a device
 value — every recorded number is a host scalar the caller already had
@@ -59,20 +68,67 @@ from typing import Callable, Dict, List, Optional
 from cup3d_tpu.obs import metrics as _metrics
 
 #: bump when the step-record keys/meaning change; tools/trace_check.py
-#: and the VALIDATION.md round-9 contract pin this
-SCHEMA_VERSION = 1
+#: and the VALIDATION.md round-9/round-13 contracts pin this.  v2
+#: (round 13): kind-tagged auxiliary records (kind="device") carry the
+#: capture-window device-time attribution from obs/profile.py.
+SCHEMA_VERSION = 2
 
 #: required keys of every step record and their types
 STEP_REQUIRED = {"schema": int, "step": int, "t": float, "dt": float,
                  "wall_s": float}
 
+#: required keys of a kind="device" auxiliary record (obs/profile.py)
+DEVICE_REQUIRED = {"schema": int, "step": int, "total_device_ms": float,
+                   "device_sections": dict}
+
+
+def _validate_device_record(rec: dict) -> List[str]:
+    """Schema-check one kind="device" auxiliary record."""
+    problems = []
+    for k, typ in DEVICE_REQUIRED.items():
+        if k not in rec:
+            problems.append(f"missing required key {k!r}")
+        elif typ is float:
+            if not isinstance(rec[k], (int, float)) or isinstance(
+                rec[k], bool
+            ):
+                problems.append(f"{k!r} must be numeric")
+        elif not isinstance(rec[k], typ) or isinstance(rec[k], bool):
+            problems.append(f"{k!r} must be {typ.__name__}")
+    if not problems and rec["schema"] != SCHEMA_VERSION:
+        problems.append(
+            f"schema {rec['schema']} != supported {SCHEMA_VERSION}"
+        )
+    if not problems and rec["step"] < 0:
+        problems.append("step must be >= 0")
+    if not problems and not all(
+        isinstance(k, str) and isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        for k, v in rec["device_sections"].items()
+    ):
+        problems.append("device_sections must map str -> ms")
+    window = rec.get("window")
+    if window is not None and not (
+        isinstance(window, list) and len(window) == 2
+        and all(isinstance(w, int) for w in window)
+    ):
+        problems.append("window must be [first_step, end_step]")
+    return problems
+
 
 def validate_step_record(rec: dict) -> List[str]:
-    """Schema-check one step record; returns a list of problems (empty =
-    valid).  Shared by the sink (debug), tests, and trace_check."""
-    problems = []
+    """Schema-check one trace record; returns a list of problems (empty
+    = valid).  Shared by the sink (debug), tests, and trace_check.
+    Dispatches on the v2 ``kind`` tag: absent/"step" is a step record,
+    "device" a capture-window attribution record."""
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not dict"]
+    kind = rec.get("kind", "step")
+    if kind == "device":
+        return _validate_device_record(rec)
+    if kind != "step":
+        return [f"unknown record kind {kind!r}"]
+    problems = []
     for k, typ in STEP_REQUIRED.items():
         if k not in rec:
             problems.append(f"missing required key {k!r}")
@@ -200,7 +256,11 @@ class TraceSink:
         self.steps_dropped = 0
         self._writer: Optional[_AsyncLineWriter] = None
         self._lock = threading.Lock()
-        self._annotation_cls = False  # unresolved; None = unavailable
+        # round-13 satellite: the TraceAnnotation class resolves ONCE at
+        # construction/configure time, so the span hot path is a single
+        # attribute load + None test instead of an import-machinery trip
+        # (None = passthrough off or jax unavailable)
+        self._annotation_cls = self._resolve_annotation()
 
     # -- configuration -----------------------------------------------------
 
@@ -222,6 +282,7 @@ class TraceSink:
         self.events.clear()
         self.steps_recorded = 0
         self.steps_dropped = 0
+        self._annotation_cls = self._resolve_annotation()
         return self
 
     def default_directory(self, directory: str) -> None:
@@ -276,20 +337,44 @@ class TraceSink:
         })
         _metrics.counter("trace.steps").inc()
 
+    def aux(self, record: dict) -> None:
+        """One kind-tagged auxiliary JSONL record interleaved with the
+        step stream (schema v2) — obs/profile.py appends the per-window
+        device-time attribution this way.  Does not count against
+        ``max_steps`` (aux records are rare: one per capture window)."""
+        if not self.enabled:
+            return
+        record = dict(record)
+        record["schema"] = SCHEMA_VERSION
+        record.setdefault("kind", "device")
+        with self._lock:
+            if self._writer is None:
+                self._writer = _AsyncLineWriter(self.jsonl_path)
+            self._writer.write(json.dumps(record) + "\n")
+        _metrics.counter("trace.aux_records").inc()
+
     # -- XLA passthrough ---------------------------------------------------
+
+    def _resolve_annotation(self):
+        """The ``jax.profiler.TraceAnnotation`` class when the XLA
+        passthrough is armed (enabled + xla_annotate) and jax imports,
+        else None.  Called once per construction/configure — NOT on the
+        span path (the round-13 satellite fix: the old lazy resolution
+        paid an import-machinery round trip under the hot span)."""
+        if not (self.enabled and self.xla_annotate):
+            return None
+        try:
+            from jax.profiler import TraceAnnotation
+
+            return TraceAnnotation
+        except Exception:  # pragma: no cover - jax-less envs
+            _metrics.counter("trace.annotation_unavailable").inc()
+            return None
 
     def annotation(self, name: str):
         """A ``jax.profiler.TraceAnnotation`` for ``name`` when the XLA
-        passthrough is on and jax is importable, else None."""
-        if not (self.enabled and self.xla_annotate):
-            return None
-        if self._annotation_cls is False:
-            try:
-                from jax.profiler import TraceAnnotation
-
-                self._annotation_cls = TraceAnnotation
-            except Exception:  # pragma: no cover - jax-less envs
-                self._annotation_cls = None
+        passthrough is on, else None — the fast no-op path is one
+        attribute load + None test (class cached at construction)."""
         cls = self._annotation_cls
         return cls(name) if cls is not None else None
 

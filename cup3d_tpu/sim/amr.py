@@ -228,6 +228,15 @@ class AMRSimulation:
             self.profiler, flight=self.flight, stream=self._pack_reader,
             kind="amr",
         )
+        # round-13 observability v2: capture windows at loop boundaries
+        # (CUP3D_PROFILE=every:N) + the env-gated /metrics//health
+        # exporter (CUP3D_METRICS_PORT); both disarmed by default
+        from cup3d_tpu.obs import export as obs_export
+        from cup3d_tpu.obs import profile as obs_profile
+
+        obs_profile.CONTROLLER.default_directory(cfg.path4serialization)
+        self._obs_profile = obs_profile.CONTROLLER
+        obs_export.ensure_exporter()
         self._last_umax = None
         self._uinf_dev = None
         self._collision_hot = False
@@ -1794,6 +1803,9 @@ class AMRSimulation:
             from cup3d_tpu.obs import metrics as obs_metrics
 
             obs_metrics.counter("resilience.ckpt_dropped").inc()
+        # close + harvest a still-open capture window before the trace
+        # flush so its device-attribution record lands in this trace
+        self._obs_profile.finish()
         obs_trace.TRACE.flush()
 
     def _log_diagnostics(self):
@@ -2377,6 +2389,9 @@ class AMRSimulation:
         eng = RecoveryEngine.install(self)
         try:
             while True:
+                # capture-window hook at the loop top (disabled: one
+                # branch; obs/profile.py)
+                self._obs_profile.on_step(self.step_idx)
                 if eng is not None and eng.on_loop_top():
                     continue  # rolled back: restart the iteration
                 try:

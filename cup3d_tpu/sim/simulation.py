@@ -66,6 +66,17 @@ class Simulation:
             self.sim.profiler, flight=self.flight,
             stream=self._pack_reader, kind="uniform",
         )
+        # round-13 observability v2 (obs/profile.py, obs/export.py):
+        # device-time capture windows at loop/K boundaries under
+        # CUP3D_PROFILE=every:N, and the env-gated /metrics//health
+        # exporter (CUP3D_METRICS_PORT) — both no-ops when disarmed,
+        # neither ever touches a device value on the step loop.
+        from cup3d_tpu.obs import export as obs_export
+        from cup3d_tpu.obs import profile as obs_profile
+
+        obs_profile.CONTROLLER.default_directory(cfg.path4serialization)
+        self._obs_profile = obs_profile.CONTROLLER
+        obs_export.ensure_exporter()
         self._last_umax: Optional[float] = None
         # round-10 resilience: simulate() installs a RecoveryEngine here
         # (CUP3D_RECOVER=1, the default); None = legacy crash-on-fault
@@ -399,6 +410,9 @@ class Simulation:
             from cup3d_tpu.obs import metrics as obs_metrics
 
             obs_metrics.counter("resilience.ckpt_dropped").inc()
+        # close + harvest a still-open capture window before the trace
+        # flush so its device-attribution record lands in this trace
+        self._obs_profile.finish()
         obs_trace.TRACE.flush()
 
     def advance(self, dt: float) -> None:
@@ -722,6 +736,10 @@ class Simulation:
         eng = RecoveryEngine.install(self)
         try:
             while True:
+                # capture-window hook at the loop top: for the megaloop
+                # this is a K boundary, so a profiler window brackets
+                # whole scan dispatches (disabled: one branch)
+                self._obs_profile.on_step(s.step)
                 try:
                     scan_now = self._scan_ready()
                     if scan_now:

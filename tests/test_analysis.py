@@ -459,6 +459,64 @@ def test_jx011_explicit_accumulator_and_scope_are_clean():
     assert not _failing(bf_elsewhere, HOT)
 
 
+def test_jx012_profiler_outside_obs_fires_suppresses_and_scopes():
+    """Direct jax.profiler use outside cup3d_tpu/obs/ (round 13): the
+    profiler session is process-global, so ad-hoc captures collide with
+    obs windows and never reach the attribution parser."""
+    src = (
+        "import jax\n"
+        "def capture(fn):\n"
+        "    jax.profiler.start_trace('/tmp/t')\n"
+        "    fn()\n"
+        "    jax.profiler.stop_trace()\n"
+    )
+    # one finding per function, at the FIRST profiler touch
+    vs = _failing(src)
+    assert [v.rule for v in vs] == ["JX012"] and vs[0].line == 3
+    assert "obs" in vs[0].message
+    # imports fire too — module-level and from-imports
+    imp = "import jax.profiler\n"
+    vs = _failing(imp, "cup3d_tpu/sim/fixture.py")
+    assert _rules(vs) == {"JX012"} and vs[0].func == "<module>"
+    frm = (
+        "from jax.profiler import TraceAnnotation\n"
+        "def mark(name):\n"
+        "    return TraceAnnotation(name)\n"
+    )
+    assert _rules(_failing(frm)) == {"JX012"}
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "    jax.profiler.start_trace",
+        "    # jax-lint: allow(JX012, standalone capture tool, no obs\n"
+        "    # window can be open here)\n"
+        "    jax.profiler.start_trace",
+    )
+    all_vs = L.lint_source(ok, HOT)
+    assert not L.failing(all_vs)
+    assert any(v.rule == "JX012" and "standalone capture" in
+               (v.suppression_reason or "") for v in all_vs)
+    # the obs layer OWNS the profiler — exempt by path
+    assert not _failing(src, "cup3d_tpu/obs/profile.py")
+    # bench.py / tools (outside the package) are exempt
+    assert not any(v.rule == "JX012" for v in _failing(src, "bench.py"))
+    assert not any(v.rule == "JX012"
+                   for v in _failing(src, "tools/capture.py"))
+
+
+def test_jx012_obs_channel_use_is_clean():
+    """Going through the obs channel never fires: CONTROLLER windows
+    and sink annotations are the sanctioned path."""
+    src = (
+        "from cup3d_tpu.obs import profile as obs_profile\n"
+        "from cup3d_tpu.obs import trace as obs_trace\n"
+        "def capture(fn):\n"
+        "    with obs_profile.CONTROLLER.capture('bench'):\n"
+        "        ann = obs_trace.TRACE.annotation('Megastep')\n"
+        "        fn()\n"
+    )
+    assert not any(v.rule == "JX012" for v in _failing(src))
+
+
 def test_wrapped_annotation_comment_blocks_parse():
     """A multi-line (wrapped) annotation applies to the next code line."""
     src = (

@@ -1,9 +1,12 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX011
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX012
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
-# swallowed-exception and JX011 bf16-reduction-accumulator rules)
+# swallowed-exception, JX011 bf16-reduction-accumulator and JX012
+# profiler-outside-obs rules)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
-# + the obs trace schema selftest (tools/trace_check.py) + bytecode
+# + the obs trace schema selftest (tools/trace_check.py), the
+# device-attribution parser selftest (obs/profile.py), the bench-
+# history regression-gate selftest (tools/perfwatch.py) + bytecode
 # compile of the whole package.  Nonzero exit on any non-baselined lint
 # finding or any syntax error.  The shipped tree carries an EMPTY
 # baseline: every finding is inline-annotated with a reason.  Run from
@@ -38,17 +41,34 @@ python -m cup3d_tpu.analysis --rules JX009 $PATHS -q
 echo "== python -m cup3d_tpu.analysis --rules JX011 cup3d_tpu/ops"
 python -m cup3d_tpu.analysis --rules JX011 cup3d_tpu/ops -q
 
+# the profiler-channel rule on its own line (round 13): direct
+# jax.profiler use outside obs/ fails CI identifiably
+echo "== python -m cup3d_tpu.analysis --rules JX012 $PATHS"
+python -m cup3d_tpu.analysis --rules JX012 $PATHS -q
+
 # fused-kernel smoke (round 12): the interpret-mode selftest exercises
 # every Pallas stage of the fused BiCGSTAB driver without a TPU
 echo "== python -m cup3d_tpu.ops.fused_bicgstab"
 JAX_PLATFORMS=cpu python -m cup3d_tpu.ops.fused_bicgstab
 
 # obs trace schema: producer -> validator round trip without a sim
-# (ISSUE 4 satellite; validates real traces with an argument instead)
+# (ISSUE 4 satellite; validates real traces with an argument instead;
+# round 13 extends it over the merged host+device Perfetto output)
 echo "== python tools/trace_check.py --selftest"
 python tools/trace_check.py --selftest
 
+# device-time attribution (round 13): synthetic capture -> parse ->
+# attribute -> merged export, plus the capture-window cadence — no TPU
+echo "== cup3d_tpu.obs.profile selftest"
+JAX_PLATFORMS=cpu python -c \
+    "from cup3d_tpu.obs import profile; profile.selftest()"
+
+# bench-history regression gate (round 13): noise quiet, 20% slowdown
+# fires, malformed store lines skipped
+echo "== python tools/perfwatch.py --selftest"
+python tools/perfwatch.py --selftest
+
 echo "== python -m compileall"
-python -m compileall -q cup3d_tpu/ tests/ bench.py
+python -m compileall -q cup3d_tpu/ tests/ tools/ bench.py
 
 echo "lint.sh: OK"

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Bench-history trajectory viewer + regression gate (ISSUE 9).
+
+Reads the append-only JSONL store ``bench.py`` writes after every run
+(``cup3d_tpu.obs.history``) and, per tracked metric (``cells_per_s``,
+``bicgstab_iter_device_ms``, ``wall_per_step_p95_s``), compares the
+newest value against the median of the previous N — the BENCH_r0x
+snapshots as a machine-checkable time series.
+
+Usage::
+
+    python tools/perfwatch.py                       # default store
+    python tools/perfwatch.py path/to/history.jsonl
+    python tools/perfwatch.py --gate                # exit 1 on regression
+    python tools/perfwatch.py --json                # machine output
+    python tools/perfwatch.py --selftest            # CI mode (lint.sh)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cup3d_tpu.obs import history as obs_history  # noqa: E402
+
+
+def _fmt_series(vals, last=8):
+    return " -> ".join(f"{v:g}" for v in vals[-last:])
+
+
+def report(store: obs_history.HistoryStore, window: int,
+           as_json: bool, last: int) -> list:
+    summaries = store.summaries()
+    reports = obs_history.detect_regressions(summaries, window=window)
+    if as_json:
+        print(json.dumps({"store": store.path, "runs": len(summaries),
+                          "reports": reports}))
+        return reports
+    print(f"perfwatch: {store.path} — {len(summaries)} run(s)")
+    for rep in reports:
+        name = rep["metric"]
+        if "reason" in rep:
+            print(f"  {name:<28} n={rep['n']}  SKIP ({rep['reason']})")
+            continue
+        spec = next(s for s in obs_history.DEFAULT_SPECS
+                    if s.name == name)
+        series = [v for v in (obs_history.extract(s, spec)
+                              for s in summaries) if v is not None]
+        verdict = "REGRESSED" if rep["regressed"] else "OK"
+        arrow = "higher=better" if rep["higher_is_better"] else "lower=better"
+        print(f"  {name:<28} {_fmt_series(series, last)}")
+        print(f"  {'':<28} current={rep['current']:g} "
+              f"baseline(median)={rep['baseline']:g} "
+              f"ratio={rep['ratio']} tol={rep['rel_tol']} "
+              f"[{arrow}]  {verdict}")
+    return reports
+
+
+def selftest() -> None:
+    """Deterministic store in a temp dir: noise stays quiet, a 20%
+    slowdown fires on every tracked metric, and the gate trips."""
+    import tempfile
+
+    def mk(cells, iter_ms, p95):
+        return {"value": cells, "unit": "cells/s",
+                "fish": {"wall_per_step_p95_s": p95,
+                         "roofline": {"bicgstab_iter_device_ms": iter_ms}}}
+
+    with tempfile.TemporaryDirectory() as td:
+        store = obs_history.HistoryStore(os.path.join(td, "hist.jsonl"))
+        # ±2-3% run noise around a stable baseline
+        for cells, ms, p95 in ((1.00e6, 2.00, 0.100),
+                               (1.02e6, 1.97, 0.098),
+                               (0.98e6, 2.03, 0.102),
+                               (1.01e6, 2.01, 0.101),
+                               (0.99e6, 1.99, 0.099)):
+            store.append(mk(cells, ms, p95))
+        assert len(store.load()) >= 2, "history store must accumulate"
+        reports = obs_history.detect_regressions(store.summaries())
+        assert not obs_history.any_regressed(reports), reports
+        # an injected 20% slowdown fires on all three metrics
+        store.append(mk(0.80e6, 2.40, 0.120))
+        reports = obs_history.detect_regressions(store.summaries())
+        by = {r["metric"]: r for r in reports}
+        for name in ("cells_per_s", "bicgstab_iter_device_ms",
+                     "wall_per_step_p95_s"):
+            assert by[name]["regressed"], (name, by[name])
+        # a malformed line is skipped, not fatal
+        with open(store.path, "a") as f:
+            f.write('{"truncated": \n')
+        assert len(store.load()) == 6
+    print("perfwatch selftest: OK")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench-history trajectory viewer + regression gate")
+    ap.add_argument("history", nargs="?",
+                    help="history JSONL (default: CUP3D_BENCH_HISTORY or "
+                         "validation/results/bench_history.jsonl)")
+    ap.add_argument("--window", type=int, default=5,
+                    help="rolling-baseline width (median of last N)")
+    ap.add_argument("--last", type=int, default=8,
+                    help="trajectory points to print per metric")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when any tracked metric regressed")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic store round trip (CI, no bench run)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        selftest()
+        return 0
+    store = obs_history.HistoryStore(args.history)
+    reports = report(store, window=args.window, as_json=args.as_json,
+                     last=args.last)
+    if args.gate and obs_history.any_regressed(reports):
+        print("perfwatch: REGRESSION gate FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

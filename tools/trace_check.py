@@ -11,17 +11,23 @@ Usage::
 Checks, per ``cup3d_tpu.obs.trace`` schema version %d:
 
 - every line parses as JSON and passes ``validate_step_record``
-  (required keys, types, schema version, non-negative steps);
-- step indices are non-decreasing;
+  (required keys, types, schema version, non-negative steps) — v2
+  ``kind="device"`` auxiliary records (obs/profile.py capture-window
+  attributions) validate against their own required-key set;
+- step indices are non-decreasing across step AND device records;
 - the Chrome trace-event export built from the records (plus, when a
   ``trace.pfto.json`` sits next to the input, that file itself) parses
   back and every event carries name/ph/ts, with step spans exposing
-  their record in ``args`` — the properties Perfetto needs to load it.
+  their record in ``args`` — the properties Perfetto needs to load it;
+- a MERGED host+device export (device ops on pid 2, obs/profile.py)
+  additionally needs a ``process_name`` metadata event for the device
+  track and a ``section`` attribution on every device op.
 
 ``--selftest`` (what ``tools/lint.sh`` runs, no simulation needed)
 drives a private TraceSink through spans + step records in a temp dir,
 then validates the files it produced — the full producer->validator
-round trip.
+round trip — and repeats it with a synthetic device attribution merged
+in (the round-13 host+device timeline).
 """
 
 from __future__ import annotations
@@ -69,15 +75,35 @@ def validate_jsonl(path: str) -> list:
     return records
 
 
-def _check_chrome(obj: dict, origin: str, want_steps: int) -> None:
+def _check_chrome(obj: dict, origin: str, want_steps: int) -> int:
+    """Validate one Chrome export; returns the number of device-track
+    ops found (0 for a host-only export)."""
+    from cup3d_tpu.obs.profile import DEVICE_PID
+
     events = obj.get("traceEvents")
     if not isinstance(events, list) or not events:
         raise SystemExit(f"{origin}: no traceEvents")
     step_spans = 0
+    device_ops = 0
+    device_named = False
     for e in events:
         for k in ("name", "ph", "ts"):
             if k not in e:
                 raise SystemExit(f"{origin}: event missing {k!r}: {e}")
+        if e.get("pid") == DEVICE_PID:
+            if e["ph"] == "M" and e["name"] == "process_name":
+                device_named = True
+                continue
+            if e["ph"] != "X":
+                continue
+            device_ops += 1
+            if "dur" not in e:
+                raise SystemExit(f"{origin}: device op without dur: {e}")
+            if "section" not in e.get("args", {}):
+                raise SystemExit(
+                    f"{origin}: device op without section attribution: {e}"
+                )
+            continue
         if e["name"] == "step":
             step_spans += 1
             args = e.get("args", {})
@@ -85,19 +111,27 @@ def _check_chrome(obj: dict, origin: str, want_steps: int) -> None:
                 raise SystemExit(
                     f"{origin}: step span without record args: {e}"
                 )
+    if device_ops and not device_named:
+        raise SystemExit(
+            f"{origin}: device ops present but no process_name metadata "
+            f"for pid {DEVICE_PID}"
+        )
     if step_spans < want_steps:
         raise SystemExit(
             f"{origin}: {step_spans} step spans < {want_steps} records"
         )
+    return device_ops
 
 
 def roundtrip_chrome(records: list, jsonl_path: str) -> None:
-    """Build a Chrome export from the records, serialize, re-parse,
-    check; then check the sibling trace.pfto.json when present."""
+    """Build a Chrome export from the step records, serialize,
+    re-parse, check; then check the sibling trace.pfto.json when
+    present (which may carry a merged device track)."""
+    steps = [r for r in records if r.get("kind", "step") == "step"]
     sink = obs_trace.TraceSink(enabled=True,
                                directory=tempfile.mkdtemp())
     t = 0.0
-    for rec in records:
+    for rec in steps:
         sink.events.append({
             "name": "step", "ph": "X", "pid": 1, "tid": 0,
             "ts": t * 1e6, "dur": rec["wall_s"] * 1e6, "args": rec,
@@ -105,7 +139,7 @@ def roundtrip_chrome(records: list, jsonl_path: str) -> None:
         t += rec["wall_s"]
         sink.steps_recorded += 1
     blob = json.dumps(sink.chrome_trace())
-    _check_chrome(json.loads(blob), "<rebuilt export>", len(records))
+    _check_chrome(json.loads(blob), "<rebuilt export>", len(steps))
     sibling = os.path.join(os.path.dirname(jsonl_path) or ".",
                            "trace.pfto.json")
     if os.path.exists(sibling):
@@ -139,7 +173,28 @@ def selftest() -> None:
         solver = records[-1]["solver"]
         assert solver["iters"] == 15.0 and solver["at_step"] == 3, solver
         roundtrip_chrome(records, os.path.join(td, "trace.jsonl"))
-    print("trace_check selftest: OK")
+    # round 13: the merged host+device timeline — a synthetic capture
+    # attributed by obs/profile.py, merged into a sink with step spans,
+    # must validate including the device track and the aux record
+    from cup3d_tpu.obs import profile as obs_profile
+
+    with tempfile.TemporaryDirectory() as td:
+        sink = obs_trace.TraceSink(enabled=True, directory=td)
+        timer = obs_trace.SpanTimer(sink=sink)
+        obsr = obs_trace.StepObserver(timer, kind="selftest")
+        for i in range(3):
+            with obsr.step(i, i * 0.1, 0.1):
+                pass
+        attr = obs_profile.attribute(obs_profile.synthetic_trace())
+        obs_profile.merge_into_sink(sink, attr, window=(0, 3))
+        sink.close()
+        records = validate_jsonl(os.path.join(td, "trace.jsonl"))
+        kinds = [r.get("kind", "step") for r in records]
+        assert kinds.count("device") == 1, kinds
+        with open(os.path.join(td, "trace.pfto.json")) as f:
+            dev_ops = _check_chrome(json.load(f), "<merged export>", 3)
+        assert dev_ops == len(attr.events), (dev_ops, len(attr.events))
+    print("trace_check selftest: OK (incl. merged host+device)")
 
 
 def main(argv=None) -> int:
@@ -165,6 +220,8 @@ def main(argv=None) -> int:
                                    or ".")
         t = 0.0
         for rec in records:
+            if rec.get("kind", "step") != "step":
+                continue
             sink.events.append({
                 "name": "step", "ph": "X", "pid": 1, "tid": 0,
                 "ts": t * 1e6, "dur": rec["wall_s"] * 1e6, "args": rec,
@@ -172,9 +229,11 @@ def main(argv=None) -> int:
             t += rec["wall_s"]
         sink.export_chrome(args.perfetto)
     with_solver = sum(1 for r in records if "solver" in r)
+    devices = sum(1 for r in records if r.get("kind") == "device")
     print(f"trace_check: OK — {len(records)} records "
           f"(steps {records[0]['step']}..{records[-1]['step']}, "
-          f"{with_solver} with solver stats)")
+          f"{with_solver} with solver stats, "
+          f"{devices} device-attribution records)")
     return 0
 
 
