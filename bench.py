@@ -1069,10 +1069,142 @@ def bench_two_fish_amr():
     }
 
 
+def bench_fleet32():
+    """Round-14 fleet serving config: B short stefanfish jobs at 32^3
+    served by ONE vmapped batch (cup3d_tpu/fleet/), against serving the
+    SAME jobs one at a time through the per-step seed path.
+
+    The headline is JOB-COMPLETE serving throughput — the regime the
+    subsystem exists for (ROADMAP item 1: many short interactive
+    scenarios, not one long run).  Both sides pay their full per-job
+    cost inside the window: the fleet pays assembly + the dispatch loop
+    + QoI fan-out; the solo baseline pays Simulation construction +
+    init + per-step advance + QoI flush per job.  Both sides are
+    measured warm (a warmup drain populates the fleet executable
+    cache; a warmup solo job populates the jit caches), so neither
+    window contains compilation.
+
+    ``fleet_cells_per_s`` counts useful lane-cells only: B x n^3 x
+    nsteps / serving wall.  ``host_dispatch_per_lane_s`` is the
+    host-side residue of the dispatch calls per lane-step — the figure
+    the batch axis divides by B.  Steady-state stepping rates for both
+    sides are reported alongside: on a single-core host the steady
+    ratio is capped at (compute + host floor) / compute because lane
+    compute serializes, while the serving ratio adds the per-job setup
+    the fleet amortizes across the whole batch.  The gate is the
+    Round-14 acceptance bar: aggregate serving throughput >= 4x the
+    single-sim figure at equal resolution."""
+    import tempfile
+
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.fleet.server import FleetServer
+    from cup3d_tpu.sim.simulation import Simulation
+
+    B = int(os.environ.get("CUP3D_BENCH_FLEET_LANES", "32"))
+    n = _scaled(32)
+    nsteps = 16  # 2 dispatches of the default K=8: a short serving job
+    spec = dict(kind="fish", n=n, nsteps=nsteps, cfl=0.3,
+                L=0.3, T=1.0, xpos=0.5)
+
+    srv = FleetServer(max_lanes=B, snap_every=10**9,
+                      workdir=tempfile.mkdtemp(prefix="cup3d-benchfleet-"))
+    # warmup round: same static signature on a short budget compiles the
+    # vmapped advance into the executable cache (fleet/server.py LRU)
+    for _ in range(B):
+        srv.submit("warmup", dict(spec, nsteps=8))
+    srv.drain()
+
+    for i in range(B):
+        srv.submit(f"lane-{i}", spec)
+    with _maybe_trace("fleet32"):
+        host = 0.0
+        t0 = time.perf_counter()
+        (batch,) = srv.assemble()
+        # jax-lint: allow(JX006, assemble() is host-only work and the
+        # warmup drain above settled every prior dispatch)
+        t_loop = time.perf_counter()
+        while (batch.left_h > 0).any():
+            # jax-lint: allow(JX006, opens the per-dispatch host-residue
+            # sample with the device deliberately still running)
+            t1 = time.perf_counter()
+            batch.dispatch()
+            # jax-lint: allow(JX006, the unsynced read is the point:
+            # host_dispatch accumulates the per-dispatch host residue
+            # while the device runs; the enclosing window settles below)
+            host += time.perf_counter() - t1
+        batch.settle()  # every QoI row consumed = all lane-steps done
+        # jax-lint: allow(JX006, settle() flushed the stream — every
+        # lane-step's QoI row was host-read, so the window is bounded
+        # by device completion)
+        t_end = time.perf_counter()
+        wall, loop_wall = t_end - t0, t_end - t_loop
+    fleet_cells = B * n**3 * nsteps / wall
+    done = srv.jobs_by_status().get("done", 0)
+
+    # the solo baseline: serve the same job one at a time through the
+    # per-step seed path (scan_k=0, pipelined off — the defaults), each
+    # job paying construction + init + stepping + QoI flush
+    def solo_job():
+        cfg = SimulationConfig(
+            bpdx=1, bpdy=1, bpdz=1, block_size=n, levelMax=1,
+            levelStart=0, extent=1.0, nu=1e-4, CFL=0.3, nsteps=nsteps,
+            tend=0.0, rampup=0, scan_k=0,
+            factory_content="stefanfish L=0.3 T=1.0 xpos=0.5",
+            dtype="float32", verbose=False, freqDiagnostics=0,
+            path4serialization=srv.workdir,
+        )
+        sim = Simulation(cfg)
+        sim.init()
+        for _ in range(nsteps):
+            sim.advance(sim.calc_max_timestep())
+        jax.block_until_ready(sim.sim.state["vel"])
+        sim.flush_packs()
+        return sim
+
+    import jax
+
+    solo_job()  # warm: first job carries every per-step compile
+    # jax-lint: allow(JX006, every solo_job ends in block_until_ready +
+    # flush_packs, so both window edges are device-synced)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        sim = solo_job()
+    # jax-lint: allow(JX006, every solo_job ends in block_until_ready +
+    # flush_packs, so both window edges are device-synced)
+    solo_wall = (time.perf_counter() - t0) / 3
+    solo_cells = n**3 * nsteps / solo_wall
+
+    # steady-state stepping rates (setup excluded) for the record
+    solo_step_wall = _time_steps(
+        sim.advance, sim.calc_max_timestep, warmup=2, iters=8,
+        tag="fleet32_solo", sync_state=lambda: sim.sim.state["vel"])
+
+    ratio = fleet_cells / max(solo_cells, 1e-9)
+    return {
+        "fleet_cells_per_s": round(fleet_cells, 1),
+        "cells_per_s": fleet_cells,  # compact-summary per-config rate
+        "solo_cells_per_s": round(solo_cells, 1),
+        "fleet_steady_cells_per_s": round(B * n**3 * nsteps / loop_wall, 1),
+        "solo_steady_cells_per_s": round(n**3 / solo_step_wall, 1),
+        "wall_per_lane_step_s": round(loop_wall / (B * nsteps), 5),
+        "host_dispatch_per_lane_s": round(host / (B * nsteps), 6),
+        "solo_job_wall_s": round(solo_wall, 3),
+        "solo_wall_per_step_s": round(solo_step_wall, 4),
+        "lanes": B,
+        "lane_steps": nsteps,
+        "dispatches": int(batch.dispatches),
+        "jobs_done": int(done),
+        "fleet_amortization_ratio": round(ratio, 2),
+        "fleet_amortization_gate": 4.0,
+        "fleet_amortization_gate_ok": bool(ratio >= 4.0),
+        "n": n,
+    }
+
+
 def main():
     which = os.environ.get("CUP3D_BENCH_CONFIG", "all")
     if which not in ("fish", "fish256", "tgv", "spectral", "amr",
-                     "channel", "amr_tgv", "all"):
+                     "channel", "amr_tgv", "fleet", "all"):
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "vs_baseline": 0,
                           "error": f"unknown CUP3D_BENCH_CONFIG {which!r}"}))
@@ -1108,10 +1240,12 @@ def main():
         ("two_fish_amr", bench_two_fish_amr),
         ("channel", bench_channel),
         ("amr_tgv", bench_amr_tgv),
+        ("fleet32", bench_fleet32),
     ):
         sel = {"fish256": None, "tgv_iterative": "tgv",
                "spectral": "spectral", "two_fish_amr": "amr",
-               "channel": "channel", "amr_tgv": "amr_tgv"}[key]
+               "channel": "channel", "amr_tgv": "amr_tgv",
+               "fleet32": "fleet"}[key]
         if which != "all" and which != sel:
             continue
         try:
@@ -1224,6 +1358,14 @@ def _compact_summary(out: dict) -> dict:
                 "ratio": d.get("recover_overhead_ratio"),
                 "gate": d.get("recover_overhead_gate"),
                 "ok": d["recover_overhead_gate_ok"],
+            }
+        if "fleet_amortization_gate_ok" in d:
+            # the round-14 acceptance bar: aggregate fleet cells/s vs
+            # the solo per-step baseline at the same resolution
+            gates["fleet_amortization"] = {
+                "ratio": d.get("fleet_amortization_ratio"),
+                "gate": d.get("fleet_amortization_gate"),
+                "ok": d["fleet_amortization_gate_ok"],
             }
         m = d.get("megaloop")
         if isinstance(m, dict) and "wall_vs_device_gate_ok" in m:

@@ -73,7 +73,15 @@ def _log_config(driver) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
-    driver = build_driver(sys.argv[1:] if argv is None else argv)
+    args = sys.argv[1:] if argv is None else argv
+    if args and args[0] == "fleet":
+        # many-simulation serving mode: `python -m cup3d_tpu fleet
+        # --scenarios spec.json` drains a multi-tenant scenario queue
+        # (fleet/server.py) and prints the per-tenant summary JSON
+        from cup3d_tpu.fleet.cli import main as fleet_main
+
+        raise SystemExit(fleet_main(args[1:]))
+    driver = build_driver(args)
     _log_config(driver)
     driver.init()
     driver.simulate()

@@ -60,6 +60,12 @@ Rule summary (full rationale in ``analysis/rules.py``):
          session is process-global, so an ad-hoc capture collides with
          obs profile windows and its trace bypasses the device-time
          attribution parser — use obs.profile capture windows instead.
+- JX013  per-lane Python loop over the scenario axis in ``cup3d_tpu/
+         fleet/`` that dispatches device work per iteration: the lane
+         axis must stay vectorized (one vmapped dispatch advances all
+         B lanes — fleet/batch.py); host-only loops over lanes are
+         fine in assembly/fan-out code because they touch no device
+         value.
 """
 
 from __future__ import annotations
@@ -132,6 +138,14 @@ HOST_METADATA_ATTRS = frozenset(
 #: precision policy stores vectors in bf16 — the only place a
 #: storage-precision reduction can reach the stopping test
 JX011_MODULE_RE = re.compile(r"cup3d_tpu/ops/")
+
+#: JX013 scope: the fleet serving layer, where the lane axis exists
+JX013_MODULE_RE = re.compile(r"cup3d_tpu/fleet/")
+
+#: names that mark a loop as walking the lane/scenario axis (matched
+#: against the loop target and every Name in the iterable expression)
+JX013_AXIS_RE = re.compile(r"(^|_)(lanes?|scenarios?)(_|$|\d)",
+                           re.IGNORECASE)
 
 #: reduction-position callables JX011 watches (the accumulator-dtype
 #: hazard lives where many elements fold into few)
@@ -405,11 +419,15 @@ class FileLint:
                 self._check_obstacle_staging(func, qualname)  # JX010
             if JX011_MODULE_RE.search(self.path):
                 self._check_bf16_reduction(func, qualname)  # JX011
+            if JX013_MODULE_RE.search(self.path):
+                self._check_lane_device_loop(func, qualname)  # JX013
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         self._check_profiler_usage(self.tree, "<module>")   # JX012
         if JX011_MODULE_RE.search(self.path):
             self._check_bf16_reduction(self.tree, "<module>")  # JX011
+        if JX013_MODULE_RE.search(self.path):
+            self._check_lane_device_loop(self.tree, "<module>")  # JX013
         return self.violations
 
     # -- plumbing ----------------------------------------------------------
@@ -991,6 +1009,45 @@ class FileLint:
                     "(dtype=/preferred_element_type=) or up-cast the "
                     "operand first (ops/precision.py policy)",
                 )
+
+    # -- JX013 -------------------------------------------------------------
+
+    def _check_lane_device_loop(self, func: ast.AST, qualname: str) -> None:
+        """Python loop over the lane/scenario axis that dispatches device
+        work per iteration (JX013, fleet/ only).  A loop 'walks the lane
+        axis' when its target or any name in its iterable matches
+        JX013_AXIS_RE (``lane``, ``lanes``, ``scenario``...); it fires
+        when the loop body then makes a device call (jnp./jax. dotted
+        call or a ``self._name(...)`` jitwrapper) — the B lanes exist to
+        be advanced by ONE vmapped dispatch, not B host dispatches.
+        Host-only lane loops (assembly, QoI fan-out) never fire."""
+        for node in _walk_shallow(func):
+            if not isinstance(node, LOOP_NODES) or isinstance(
+                    node, ast.While):
+                continue  # while has no axis target to classify
+            if isinstance(node, ast.For):
+                axis_src = [node.target, node.iter]
+            else:  # comprehensions: every generator's target + iterable
+                axis_src = [p for g in node.generators
+                            for p in (g.target, g.iter)]
+            names: Set[str] = set()
+            for piece in axis_src:
+                names |= _names_in(piece)
+                names |= {a.attr for a in ast.walk(piece)
+                          if isinstance(a, ast.Attribute)}
+            if not any(JX013_AXIS_RE.search(n) for n in names):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and _is_device_call(sub):
+                    self._emit(
+                        "JX013", sub, qualname,
+                        f"`{_call_name(sub)}()` dispatches device work "
+                        "per iteration of a lane/scenario-axis loop; "
+                        "vectorize over the batch axis instead "
+                        "(fleet/batch.py vmap advance, lane-masked "
+                        "jnp.where selects)",
+                    )
+                    break
 
     # -- JX009 -------------------------------------------------------------
 

@@ -156,6 +156,21 @@ RULES: Dict[str, Rule] = {
             "accumulator explicitly.",
         ),
         Rule(
+            "JX013",
+            "per-lane Python loop over the fleet scenario axis",
+            "A Python loop that walks the lane/scenario axis AND "
+            "dispatches device work per iteration inside cup3d_tpu/"
+            "fleet/ undoes the entire fleet amortization: B lanes "
+            "exist to be advanced by ONE vmapped dispatch "
+            "(fleet/batch.py), so a per-lane device loop pays the "
+            "~0.03 s/step host overhead B times over — exactly the "
+            "floor BENCH_r04/r05 measured and the fleet was built to "
+            "amortize.  The batch axis must stay vectorized (vmap / "
+            "lane-masked selects); host-only Python loops over lanes "
+            "are fine in assembly and fan-out code because they touch "
+            "no device value.",
+        ),
+        Rule(
             "JX012",
             "direct jax.profiler use outside the obs layer",
             "jax.profiler.start_trace/stop_trace/TraceAnnotation called "

@@ -1,0 +1,28 @@
+"""cup3d_tpu.fleet: vmapped many-simulation batching + multi-tenant
+job serving.
+
+- :mod:`fleet.batch` — the megaloop scan body vmapped over a leading
+  ``lane`` (scenario) axis, with optional device sharding of the lane
+  axis (CUP3D_FLEET_MESH).
+- :mod:`fleet.server` — job queue, capacity-bucketed batch assembly,
+  the dispatch loop, and per-tenant QoI fan-out.
+- :mod:`fleet.isolate` — per-lane fault isolation: lane-scoped
+  rollback with dt-halving; healthy lanes bitwise untouched.
+"""
+
+from cup3d_tpu.fleet.batch import (  # noqa: F401
+    build_fleet_advance,
+    fleet_mesh,
+    stack_carries,
+    stack_gaits,
+)
+from cup3d_tpu.fleet.server import (  # noqa: F401
+    CANCELLED,
+    DONE,
+    FAILED,
+    QUEUED,
+    RUNNING,
+    FleetJob,
+    FleetServer,
+    live_servers,
+)

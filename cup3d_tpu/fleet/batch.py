@@ -1,0 +1,181 @@
+"""Vmapped many-simulation batching: one dispatch advances B lanes.
+
+BENCH_r04/r05 put every config's floor at ~0.03 s/step of host overhead.
+The megaloop (PR 6) amortizes that over K steps of ONE simulation; this
+module amortizes it over *scenarios* by laying a leading ``lane`` axis
+over the megaloop scan body (sim/megaloop.make_tgv_step /
+make_fish_step) with ``jax.vmap``:
+
+- the batched carry stacks vel/p/chi/udef + the 6-DOF rigid vector and
+  internal quaternion per lane, so every lane owns its own state;
+- the (umax, time, dt) chain is per-lane carry state, so each lane runs
+  its own dt policy (stale-umax CFL bound + 1.03x growth limiter) with
+  no cross-lane coupling;
+- per-lane frozen-gait parameters (models/fish/device_midline.
+  freeze_gait) are stacked into a batched pytree and passed as traced
+  arguments, so lanes in one executable swim different gaits;
+- a per-lane integer ``left`` budget gates the scan body: a lane with
+  ``left == 0`` (finished, retired, or padding) has its carry passed
+  through a lane-wise ``jnp.where`` select, which reproduces the frozen
+  bits exactly — the foundation of the isolation contract
+  (fleet/isolate.py, VALIDATION.md "Round 14").
+
+Every operation in the scan body is elementwise over the lane axis under
+vmap (per-lane FFTs, per-lane reductions, per-lane while_loops), so lane
+trajectories are mutually independent: NaNs cannot cross lanes, and a
+frozen or rolled-back lane never perturbs another lane's bits.
+
+Optionally the lane axis is sharded over devices through the
+parallel/compat.py shard_map wrapper (CUP3D_FLEET_MESH=1): the body has
+no cross-lane collective, so the per-device program is the unmodified
+vmapped advance over the local lane shard.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.sim.megaloop import (  # noqa: F401  (rows re-exported)
+    FISH_ROW,
+    TGV_ROW,
+    init_fish_carry,
+    init_tgv_carry,
+    make_fish_step,
+    make_tgv_step,
+)
+
+#: carry key holding the per-lane remaining-step budget (int32, (B,))
+LEFT = "left"
+
+
+def stack_gaits(gaits, dtype):
+    """Per-lane frozen-gait dicts -> one batched pytree (leading lane
+    axis).  Python-float leaves become (B,) device scalars so vmap can
+    batch them (the solo megaloop bakes them in as constants instead);
+    array leaves must share shape across lanes — mixed midline
+    discretizations belong in different buckets (fleet/server.py keys
+    assembly on the static signature)."""
+    keys = sorted(gaits[0])
+    for g in gaits:
+        if sorted(g) != keys:
+            raise ValueError("lane gaits disagree on parameter set")
+    out = {}
+    for k in keys:
+        leaves = [jnp.asarray(g[k], dtype) for g in gaits]
+        shapes = {leaf.shape for leaf in leaves}
+        if len(shapes) != 1:
+            raise ValueError(
+                f"gait leaf {k!r} varies in shape across lanes: {shapes}"
+            )
+        out[k] = jnp.stack(leaves)
+    return out
+
+
+def stack_carries(carries, targets):
+    """Stack per-lane solo carries (init_tgv_carry / init_fish_carry
+    outputs) into one batched carry, attaching the per-lane ``left``
+    budget.  ``targets[b] <= 0`` marks lane b as padding: its state is a
+    clone that the gated body freezes from step 0."""
+    keys = sorted(carries[0])
+    for c in carries:
+        if sorted(c) != keys:
+            raise ValueError("lane carries disagree on state set")
+    out = {k: jnp.stack([c[k] for c in carries]) for k in keys}
+    out[LEFT] = jnp.asarray(np.asarray(targets, np.int32))
+    return out
+
+
+def _gated(core, has_gait):
+    """Wrap a solo scan body with the per-lane freeze gate.  Inside vmap
+    each lane sees scalar ``left``; a finished/retired/padding lane
+    (left == 0) recomputes the step but keeps its old carry through an
+    elementwise select — bit-exact freezing, no shape change, and the
+    rows it produces are replays the consumer drops by budget."""
+    if has_gait:
+        def body(gait, carry, cfl_eff):
+            left = carry[LEFT]
+            act = left > 0
+            inner = {k: v for k, v in carry.items() if k != LEFT}
+            new, row = core(gait, inner, cfl_eff)
+            merged = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(act, n, o), new, inner)
+            merged[LEFT] = left - act.astype(left.dtype)
+            return merged, row
+    else:
+        def body(gait, carry, cfl_eff):
+            del gait
+            left = carry[LEFT]
+            act = left > 0
+            inner = {k: v for k, v in carry.items() if k != LEFT}
+            new, row = core(inner, cfl_eff)
+            merged = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(act, n, o), new, inner)
+            merged[LEFT] = left - act.astype(left.dtype)
+            return merged, row
+    return body
+
+
+def fleet_mesh() -> Optional["jax.sharding.Mesh"]:
+    """The optional lanes mesh: a 1-D device mesh named ``lanes`` when
+    CUP3D_FLEET_MESH is on and more than one device is visible, else
+    None (pure vmap on the default device)."""
+    if os.environ.get("CUP3D_FLEET_MESH", "0").lower() not in (
+            "1", "true", "on"):
+        return None
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    return jax.sharding.Mesh(np.asarray(devs), ("lanes",))
+
+
+def mesh_lane_multiple(mesh) -> int:
+    """Lane counts must divide evenly over the mesh; 1 when unsharded."""
+    return int(mesh.devices.size) if mesh is not None else 1
+
+
+def build_fleet_advance(s, ob=None, mesh=None):
+    """jitted ``(carry_B, cfl (B, K), gaits_B) -> (carry_B', rows
+    (B, K, ROW))``: B independent lanes, K steps each, one dispatch.
+
+    ``s`` is the bucket's template Simulation (grid, solver, statics);
+    ``ob`` its template obstacle for the fish pipeline (None selects the
+    obstacle-free body, where ``gaits`` is passed as None).  With a
+    ``mesh`` the lane axis is sharded across devices via the
+    parallel/compat.py shard_map wrapper — the body is collective-free,
+    so each device runs the vmapped advance over its lane shard.
+
+    The carry is deliberately NOT donated: the batched advance's result
+    feeds lane-wise where-selects against the previous carry on the
+    rollback path (fleet/isolate.py), so the pre-dispatch buffers must
+    stay valid until the isolation layer releases them."""
+    has_gait = ob is not None
+    core = make_fish_step(s, ob) if has_gait else make_tgv_step(s)
+    body = _gated(core, has_gait)
+
+    def lane_scan(gait, carry, cfl_eff):
+        return jax.lax.scan(
+            lambda c, x: body(gait, c, x), carry, cfl_eff)
+
+    gait_axes = 0 if has_gait else None
+
+    def advance(carry, cfl_eff, gaits):
+        return jax.vmap(lane_scan, in_axes=(gait_axes, 0, 0))(
+            gaits, carry, cfl_eff)
+
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from cup3d_tpu.parallel.compat import shard_map
+
+        lanes = P("lanes")
+        advance = shard_map(
+            advance, mesh,
+            in_specs=(lanes, lanes, lanes),
+            out_specs=(lanes, lanes),
+        )
+    return jax.jit(advance)

@@ -1,0 +1,57 @@
+"""Headless fleet entrypoint: ``python -m cup3d_tpu fleet --scenarios
+spec.json``.
+
+The spec file is either a JSON list of scenario dicts or an object
+``{"scenarios": [...], "lanes": N, "buckets": N}``.  Each scenario is a
+fleet/server.py job spec (kind, nsteps, n, cfl, L/T/xpos, ...) plus an
+optional ``tenant`` name.  The process drains the whole queue and
+prints the per-tenant summary JSON on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List, Optional
+
+from cup3d_tpu.fleet.server import FleetServer, summary_json
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cup3d_tpu fleet",
+        description="drain a fleet scenario spec and print the "
+                    "per-tenant summary JSON")
+    ap.add_argument("--scenarios", required=True,
+                    help="JSON spec: a list of scenarios or "
+                         '{"scenarios": [...], "lanes": N, "buckets": N}')
+    ap.add_argument("--lanes", type=int, default=None,
+                    help="max lanes per batch (CUP3D_FLEET_LANES)")
+    ap.add_argument("--buckets", type=int, default=None,
+                    help="executable cache cap (CUP3D_FLEET_BUCKETS)")
+    ap.add_argument("--workdir", default=None,
+                    help="serialization dir (default: fresh tempdir)")
+    args = ap.parse_args(argv)
+
+    with open(args.scenarios) as f:
+        spec = json.load(f)
+    if isinstance(spec, dict):
+        scenarios = spec.get("scenarios", [])
+        lanes = args.lanes if args.lanes is not None else spec.get("lanes")
+        buckets = (args.buckets if args.buckets is not None
+                   else spec.get("buckets"))
+    else:
+        scenarios, lanes, buckets = spec, args.lanes, args.buckets
+    if not scenarios:
+        raise SystemExit("no scenarios in spec")
+
+    server = FleetServer(max_lanes=lanes, max_buckets=buckets,
+                         workdir=args.workdir)
+    for i, sc in enumerate(scenarios):
+        server.submit(sc.get("tenant", f"tenant-{i}"), sc)
+    summary = server.drain()
+    print(summary_json(summary))
+    bad = sum(
+        st.get("failed", 0) for st in
+        (t["statuses"] for t in summary.values()))
+    return 1 if bad else 0

@@ -1,0 +1,188 @@
+"""Per-lane fault isolation: one lane's NaN never touches its neighbors.
+
+The solo resilience layer (resilience/recovery.py) rolls the WHOLE
+simulation back to a snapshot; at fleet scale that would punish B-1
+healthy tenants for one lane's blow-up.  This module scopes recovery to
+the lane:
+
+- detection runs on the consumed QoI rows (per-lane umax/dt chain), so
+  it rides the stream's async cadence with zero extra device traffic;
+- a faulted lane is rolled back to the rolling batch snapshot through a
+  lane-wise ``jnp.where`` select — an elementwise copy for the masked
+  lane and a bit-exact passthrough for every other lane (the vmapped
+  scan body has no cross-lane op, so healthy lanes are bitwise
+  unaffected end to end: VALIDATION.md "Round 14");
+- the restored lane's carried dt is halved per attempt (the same
+  geometric backoff as RecoveryEngine.scale_dt), which the in-scan
+  1.03x growth limiter then recovers from gradually;
+- a lane that keeps faulting past ``max_retries`` is retired (its
+  ``left`` budget is zeroed, so the gated scan body freezes its carry)
+  and flagged to the server, which fails only that tenant's job.
+
+Fault seams (resilience/faults.py): ``step.nan_velocity`` fires on the
+per-lane step chain exactly as in the solo consumer, and the
+lane-addressed ``fleet.lane_nan`` site (armed with the LANE index in
+the step slot) poisons one chosen lane for the isolation tests.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cup3d_tpu.fleet.batch import LEFT
+from cup3d_tpu.obs import metrics as M
+from cup3d_tpu.resilience import faults
+
+#: lane lifecycle states (host-side; the device only sees ``left``)
+LANE_RUNNING = "running"
+LANE_DONE = "done"
+LANE_FAILED = "failed"
+LANE_CANCELLED = "cancelled"
+LANE_PADDING = "padding"
+
+DEFAULT_MAX_RETRIES = 4
+
+
+def _max_retries() -> int:
+    try:
+        return int(os.environ.get("CUP3D_MAX_RETRIES", DEFAULT_MAX_RETRIES))
+    # jax-lint: allow(JX009, malformed env knob falls back to the
+    # default retry budget; the effective value is reported in the
+    # server's health payload)
+    except ValueError:
+        return DEFAULT_MAX_RETRIES
+
+
+@jax.jit
+def _select_lanes(mask, a, b):
+    """Lane-wise select over a carry pytree: ``a`` where ``mask`` (B,),
+    else ``b``.  jnp.where is an elementwise select, so unselected lanes
+    come through with their bits untouched."""
+    def sel(x, y):
+        m = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+        return jnp.where(m, x, y)
+
+    return jax.tree_util.tree_map(sel, a, b)
+
+
+@jax.jit
+def _scale_lane_dt(carry, mask, scale):
+    out = dict(carry)
+    out["dt"] = jnp.where(mask, carry["dt"] * scale, carry["dt"])
+    return out
+
+
+@jax.jit
+def _zero_lane_left(carry, mask):
+    out = dict(carry)
+    out[LEFT] = jnp.where(mask, 0, carry[LEFT])
+    return out
+
+
+def restore_lanes(carry, snap, mask_np, dt_scale):
+    """Roll the masked lanes back to ``snap`` with their carried dt
+    scaled by ``dt_scale``; every unmasked lane keeps its exact bits."""
+    mask = jnp.asarray(np.asarray(mask_np, bool))
+    out = _select_lanes(mask, snap, carry)
+    return _scale_lane_dt(out, mask, jnp.asarray(dt_scale, out["dt"].dtype))
+
+
+def retire_lanes(carry, mask_np):
+    """Zero the masked lanes' ``left`` budget so the gated scan body
+    freezes them; unmasked lanes keep their exact bits."""
+    mask = jnp.asarray(np.asarray(mask_np, bool))
+    return _zero_lane_left(carry, mask)
+
+
+class LaneGuard:
+    """Per-batch isolation state: the rolling snapshot, per-lane epochs
+    (stale-row filtering across rollbacks), and per-lane retry budgets.
+
+    The guard owns no device dispatch loop — the server calls
+    ``snapshot()`` at validated boundaries and ``check_row()`` from its
+    stream consumer; ``rollback()``/``give_up()`` return the corrected
+    batched carry."""
+
+    def __init__(self, nlanes: int, max_retries: Optional[int] = None):
+        self.B = int(nlanes)
+        self.max_retries = (
+            _max_retries() if max_retries is None else int(max_retries))
+        self.epochs = np.zeros(self.B, np.int64)
+        self.attempts = np.zeros(self.B, np.int64)
+        self.fail_step = np.full(self.B, -1, np.int64)
+        self.rollbacks = 0
+        self.snap = None
+        self.snap_step = np.zeros(self.B, np.int64)
+        self.snap_left = np.zeros(self.B, np.int64)
+
+    # -- rolling snapshot --------------------------------------------------
+
+    def snapshot(self, carry, step_h, left_h) -> None:
+        """Copy the batched carry (and the host step/budget mirrors) as
+        the per-lane rollback target.  Callers must only snapshot a
+        VALIDATED state: every emitted row up to it consumed clean."""
+        self.snap = jax.tree_util.tree_map(jnp.copy, carry)
+        self.snap_step = np.asarray(step_h, np.int64).copy()
+        self.snap_left = np.asarray(left_h, np.int64).copy()
+
+    # -- detection ---------------------------------------------------------
+
+    def check_row(self, lane: int, step: int, umax: float,
+                  dt: float) -> Optional[str]:
+        """Classify one consumed lane row; None when healthy.  The
+        injection seams run first so a test fault poisons the chain at
+        exactly the armed (lane, step)."""
+        if faults.fire("fleet.lane_nan", lane):
+            return "nan-velocity"
+        if faults.fire("step.nan_velocity", step):
+            return "nan-velocity"
+        if not (math.isfinite(umax) and math.isfinite(dt)):
+            return "nan-velocity"
+        if dt <= 0.0:
+            return "dt-collapse"
+        return None
+
+    def note_progress(self, lane: int, step: int) -> None:
+        """A clean row past the last failure point closes the incident:
+        the retry budget re-arms (RecoveryEngine's retire semantics)."""
+        if self.fail_step[lane] >= 0 and step > self.fail_step[lane]:
+            self.fail_step[lane] = -1
+            self.attempts[lane] = 0
+
+    # -- recovery ----------------------------------------------------------
+
+    def exhausted(self, lane: int) -> bool:
+        return bool(self.attempts[lane] >= self.max_retries)
+
+    def rollback(self, carry, lane: int, step: int, reason: str):
+        """Roll ONE lane back to the rolling snapshot with dt halved per
+        attempt.  Returns (carry', snap_step, snap_left) for the host
+        mirrors; the lane's epoch bump invalidates every in-flight row
+        it emitted on the abandoned trajectory."""
+        if self.snap is None:
+            raise RuntimeError("lane rollback requested before any snapshot")
+        self.attempts[lane] += 1
+        self.fail_step[lane] = max(self.fail_step[lane], int(step))
+        self.epochs[lane] += 1
+        self.rollbacks += 1
+        mask = np.zeros(self.B, bool)
+        mask[lane] = True
+        scale = 0.5 ** int(self.attempts[lane])
+        M.counter("fleet.lane_rollbacks", reason=reason).inc()
+        out = restore_lanes(carry, self.snap, mask, scale)
+        return out, int(self.snap_step[lane]), int(self.snap_left[lane])
+
+    def give_up(self, carry, lane: int, reason: str):
+        """Retire a lane that exhausted its retries: freeze its carry
+        (left = 0) and bump its epoch so stale rows drop."""
+        self.epochs[lane] += 1
+        mask = np.zeros(self.B, bool)
+        mask[lane] = True
+        M.counter("fleet.lane_giveups", reason=reason).inc()
+        return retire_lanes(carry, mask)

@@ -1,0 +1,614 @@
+"""Multi-tenant fleet server: job queue -> bucketed batches -> dispatch.
+
+The serving pipeline, one layer per concern:
+
+1. **Queue** — ``submit(tenant, spec)`` validates a scenario spec and
+   enqueues a :class:`FleetJob`; ``poll``/``cancel`` give tenants the
+   usual lifecycle, ``drain`` runs the dispatch loop to completion.
+2. **Capacity-bucketed assembly** — queued jobs group by their *static
+   signature* (grid shape, dtype, solver, fish geometry: everything
+   that changes the compiled executable) plus a ×1.25 ladder rung of
+   their step budget (grid/bucket.py's ladder idea, re-applied to the
+   lane and step axes), and each group is padded up the lane ladder —
+   so mixed workloads share a small, bounded set of executables and the
+   RecompileCounter budget is #buckets, not #jobs.
+3. **Dispatch loop** — each batch advances all its lanes K steps per
+   dispatch through the vmapped advance (fleet/batch.py), emitting one
+   (B, K, ROW) QoI block per dispatch into a stream/qoi.py
+   :class:`QoIStream` (async copy, bounded in-flight window).
+4. **Fan-out** — the stream consumer splits rows per lane, runs the
+   per-lane failure detection (fleet/isolate.py), and appends each
+   tenant's rows in (step) order into that job's QoI buffer — a
+   deterministic, byte-stable ordering per tenant.
+
+Env knobs: ``CUP3D_FLEET_LANES`` caps lanes per batch (default 64),
+``CUP3D_FLEET_BUCKETS`` caps the executable cache (default 8, LRU),
+``CUP3D_FLEET_MESH=1`` shards the lane axis over visible devices, and
+``CUP3D_SNAP_EVERY``/``CUP3D_MAX_RETRIES`` carry their resilience/
+meanings per lane.  Live servers surface in the obs /health payload
+(obs/export.py) through the same weakref registry pattern as the
+flight recorders.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import weakref
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cup3d_tpu.config import SimulationConfig
+from cup3d_tpu.fleet import batch as FB
+from cup3d_tpu.fleet import isolate as ISO
+from cup3d_tpu.grid.bucket import count_capacity
+from cup3d_tpu.obs import metrics as M
+from cup3d_tpu.sim.dtpolicy import ramped_cfl
+from cup3d_tpu.sim.megaloop import (
+    DEFAULT_SCAN_K,
+    FISH_ROW,
+    TGV_ROW,
+    resolve_scan_k,
+)
+from cup3d_tpu.stream.qoi import QoIStream
+
+# job lifecycle states
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+#: lane-count ladder base: fleet batches start amortizing at 2 lanes
+LANE_LADDER_BASE = 2
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    # jax-lint: allow(JX009, malformed env knob falls back to the
+    # default; the effective value is visible in health()["knobs"])
+    except ValueError:
+        return default
+
+
+@dataclass
+class FleetJob:
+    """One tenant scenario: spec in, per-step QoI rows + final lane
+    state out."""
+
+    job_id: str
+    tenant: str
+    spec: dict
+    status: str = QUEUED
+    nsteps: int = 0
+    steps_done: int = 0
+    time: float = 0.0
+    error: Optional[str] = None
+    rows: Optional[np.ndarray] = None  # (nsteps, ROW) float64, step order
+    lane: int = -1
+    batch: Optional["FleetBatch"] = None
+    cfg: Optional[SimulationConfig] = None
+
+    def record(self, step: int, row: np.ndarray, t: float) -> None:
+        """Append (or re-apply, after a lane rollback replay) the QoI
+        row for ``step``; keyed by step index, so the final buffer is a
+        clean, gap-free, byte-stable sequence per tenant."""
+        if 0 <= step < self.nsteps:
+            self.rows[step] = row
+            self.steps_done = max(self.steps_done, step + 1)
+            self.time = t
+
+    def summary(self) -> dict:
+        return {
+            "job_id": self.job_id,
+            "tenant": self.tenant,
+            "status": self.status,
+            "steps_done": int(self.steps_done),
+            "nsteps": int(self.nsteps),
+            "time": float(self.time),
+            "error": self.error,
+        }
+
+    def qoi_bytes(self) -> bytes:
+        """The tenant's QoI block as bytes (ordering-stability tests)."""
+        return b"" if self.rows is None else self.rows.tobytes()
+
+
+def _job_config(spec: dict, workdir: str) -> Tuple[str, SimulationConfig]:
+    """Scenario spec -> (kind, SimulationConfig) for one uniform
+    pipelined lane.  Only scan-eligible configs are expressible: free
+    dt, step-budget termination, <= 1 frozen-gait obstacle."""
+    kind = str(spec.get("kind", "fish"))
+    nsteps = int(spec.get("nsteps", 0))
+    if nsteps <= 0:
+        raise ValueError("fleet scenario needs nsteps > 0")
+    n = int(spec.get("n", 32))
+    common = dict(
+        bpdx=1, bpdy=1, bpdz=1, block_size=n,
+        levelMax=1, levelStart=0,
+        nsteps=nsteps, tend=0.0,
+        CFL=float(spec.get("cfl", 0.3)),
+        rampup=int(spec.get("rampup", 0)),
+        dtype=str(spec.get("dtype", "float32")),
+        pipelined=True, verbose=False, freqDiagnostics=0,
+        path4serialization=workdir,
+    )
+    if kind == "tgv":
+        cfg = SimulationConfig(
+            extent=float(spec.get("extent", 2.0 * np.pi)),
+            nu=float(spec.get("nu", 0.02)),
+            initCond=str(spec.get("initCond", "taylorGreen")),
+            **common,
+        )
+    elif kind == "fish":
+        L = float(spec.get("L", 0.3))
+        T = float(spec.get("T", 1.0))
+        xpos = float(spec.get("xpos", 0.5))
+        factory = f"stefanfish L={L} T={T} xpos={xpos}"
+        for k in ("ypos", "zpos"):
+            if k in spec:
+                factory += f" {k}={float(spec[k])}"
+        cfg = SimulationConfig(
+            extent=float(spec.get("extent", 1.0)),
+            nu=float(spec.get("nu", 1e-4)),
+            factory_content=factory,
+            **common,
+        )
+    else:
+        raise ValueError(f"unknown fleet scenario kind {kind!r}")
+    return kind, cfg
+
+
+def _static_signature(drv, kind: str) -> tuple:
+    """Everything that changes the compiled lane body: jobs sharing a
+    signature (and a lane/step rung) share one executable."""
+    s = drv.sim
+    sig = (
+        kind,
+        tuple(int(v) for v in np.asarray(s.grid.shape)),
+        str(np.dtype(s.dtype)),
+        float(s.grid.h),
+        float(s.nu),
+        type(s.poisson_solver).__name__,
+    )
+    if s.obstacles:
+        ob = s.obstacles[0]
+        sig += (
+            float(ob.length),
+            bool(ob.bFixFrameOfRef),
+            tuple(int(v) for v in ob._window_shape),
+            tuple(np.asarray(ob.forced_mask_dev()).astype(float).tolist()),
+            tuple(np.asarray(ob.block_mask_dev()).astype(float).tolist()),
+            float(drv.cfg.DLM),
+            float(drv.cfg.lambda_penalization),
+        )
+    return sig
+
+
+class FleetBatch:
+    """B lanes sharing one compiled executable: the batched carry, the
+    host step/budget mirrors, the lane guard, and the QoI stream."""
+
+    def __init__(self, server: "FleetServer", batch_id: int, kind: str,
+                 jobs: List[FleetJob], drivers: list, K: int, cap: int):
+        self.server = server
+        self.batch_id = batch_id
+        self.kind = kind
+        self.K = int(K)
+        self.B = int(cap)
+        self.row_w = FISH_ROW if kind == "fish" else TGV_ROW
+        # row offsets of the per-lane (umax, dt, time) chain
+        self.off_umax = self.row_w - 3
+        self.off_dt = self.row_w - 2
+        self.off_time = self.row_w - 1
+
+        template = drivers[0]
+        self.template = template
+        s = template.sim
+        self.np_dtype = np.dtype(s.dtype)
+
+        # lane assembly: per-job solo carries + frozen gaits, padded up
+        # the lane ladder with inert clones of lane 0 (left = 0 from
+        # step 0, so the gated body freezes them; they are never
+        # consumed because jobs[lane] is None there)
+        carries, gaits, targets = [], [], []
+        for job, drv in zip(jobs, drivers):
+            if kind == "fish":
+                ob = drv.sim.obstacles[0]
+                from cup3d_tpu.models.fish.device_midline import freeze_gait
+
+                gait = freeze_gait(ob, drv.sim.time, drv.sim.dtype)
+                if gait is None:
+                    raise ValueError(
+                        f"{job.job_id}: gait not freezable for fleet")
+                gaits.append(gait)
+                carries.append(FB.init_fish_carry(drv.sim, ob))
+            else:
+                carries.append(FB.init_tgv_carry(drv.sim))
+            targets.append(job.nsteps)
+        while len(carries) < self.B:
+            carries.append(carries[0])
+            targets.append(0)
+            if gaits:
+                gaits.append(gaits[0])
+        self.jobs: List[Optional[FleetJob]] = list(jobs) + [None] * (
+            self.B - len(jobs))
+        for lane, job in enumerate(jobs):
+            job.lane = lane
+            job.batch = self
+            job.status = RUNNING
+            job.rows = np.zeros((job.nsteps, self.row_w), np.float64)
+
+        self.carry = FB.stack_carries(carries, targets)
+        self.gaits = FB.stack_gaits(gaits, s.dtype) if gaits else None
+        ob = s.obstacles[0] if kind == "fish" else None
+        self.advance = server.executable(
+            _static_signature(template, kind), s, ob, self.B, self.K)
+
+        self.step_h = np.zeros(self.B, np.int64)
+        self.left_h = np.asarray(targets, np.int64)
+        self.snap_dispatches = max(1, server.snap_steps // self.K)
+        self.guard = ISO.LaneGuard(self.B, server.max_retries)
+        self.guard.snapshot(self.carry, self.step_h, self.left_h)
+        self._since_snap = 0
+        self.dispatches = 0
+        self.stream = QoIStream(
+            self._consume, read_every=1, max_inflight=2,
+            name=f"fleet-b{batch_id}")
+        M.counter("fleet.batches").inc()
+        M.counter("fleet.lanes", kind=kind).inc(len(jobs))
+
+    # -- dispatch ----------------------------------------------------------
+
+    def active(self) -> bool:
+        return bool(
+            (self.left_h > 0).any()
+            or self.stream
+            or any(j is not None and j.status == RUNNING for j in self.jobs)
+        )
+
+    def _cfl_block(self) -> np.ndarray:
+        """Host-precomputed per-lane CFL ramp for the next K steps —
+        the same dtpolicy.ramped_cfl chain the solo megaloop feeds, per
+        lane (host fan-out loop: no device work here)."""
+        cfl = np.empty((self.B, self.K), self.np_dtype)
+        for lane in range(self.B):
+            job = self.jobs[lane]
+            base = float(job.cfg.CFL) if job is not None else 0.1
+            ramp = int(job.cfg.rampup) if job is not None else 0
+            step0 = int(self.step_h[lane])
+            for k in range(self.K):
+                cfl[lane, k] = ramped_cfl(base, step0 + k, ramp)
+        return cfl
+
+    def dispatch(self) -> None:
+        """One batched advance: every live lane moves K steps, one QoI
+        block goes onto the stream."""
+        valid = np.minimum(self.left_h, self.K).astype(np.int64)
+        carry, rows = self.advance(self.carry, self._cfl_block(), self.gaits)
+        self.carry = carry
+        entry = self.stream.pack_parts(
+            [("scan", rows.reshape(self.B * self.K * self.row_w))],
+            self.template.sim.dtype,
+            step0=self.step_h.copy(), valid=valid,
+            epochs=self.guard.epochs.copy(),
+            step=int(self.dispatches),
+        )
+        self.stream.emit(entry)
+        self.step_h += valid
+        self.left_h -= valid
+        self.dispatches += 1
+        self._since_snap += 1
+        M.counter("fleet.dispatches").inc()
+        if self._since_snap >= self.snap_dispatches:
+            self.settle()
+            self.guard.snapshot(self.carry, self.step_h, self.left_h)
+            self._since_snap = 0
+
+    def settle(self) -> None:
+        """Drain the stream: every emitted row is consumed (and every
+        lane fault handled) before the caller proceeds.  Required
+        before snapshots — only a validated state may become a rollback
+        target."""
+        self.stream.flush()
+
+    def tick(self) -> None:
+        """One dispatch-loop turn: advance if any lane has budget, else
+        drain the stream (which may resurrect budget via rollback)."""
+        if (self.left_h > 0).any():
+            self.dispatch()
+        else:
+            self.settle()
+
+    # -- fan-out + isolation ----------------------------------------------
+
+    def _consume(self, entry: dict) -> None:
+        vals = entry.get("vals")
+        if vals is None:
+            vals = np.asarray(entry["pack"], np.float64)
+        rows = np.asarray(vals, np.float64).reshape(
+            self.B, self.K, self.row_w)
+        step0, valid = entry["step0"], entry["valid"]
+        epochs = entry["epochs"]
+        for lane in range(self.B):
+            job = self.jobs[lane]
+            if job is None or job.status != RUNNING:
+                continue
+            if epochs[lane] != self.guard.epochs[lane]:
+                continue  # stale rows from an abandoned lane trajectory
+            for k in range(int(valid[lane])):
+                step = int(step0[lane]) + k
+                row = rows[lane, k]
+                reason = self.guard.check_row(
+                    lane, step, float(row[self.off_umax]),
+                    float(row[self.off_dt]))
+                if reason is not None:
+                    self.lane_fault(lane, step, reason)
+                    break
+                self.guard.note_progress(lane, step)
+                job.record(step, row, float(row[self.off_time]))
+                if job.steps_done >= job.nsteps:
+                    self.retire(lane, DONE, "done")
+                    break
+
+    def lane_fault(self, lane: int, step: int, reason: str) -> None:
+        """Contain one lane's failure: rollback with dt-halving while
+        the retry budget lasts, retire the lane after."""
+        M.counter("fleet.lane_faults", reason=reason).inc()
+        if self.guard.exhausted(lane):
+            self.carry = self.guard.give_up(self.carry, lane, reason)
+            self.left_h[lane] = 0
+            job = self.jobs[lane]
+            job.error = reason
+            self.retire(lane, FAILED, "failed")
+            return
+        self.carry, snap_step, snap_left = self.guard.rollback(
+            self.carry, lane, step, reason)
+        self.step_h[lane] = snap_step
+        self.left_h[lane] = snap_left
+
+    def retire(self, lane: int, status: str, reason: str) -> None:
+        job = self.jobs[lane]
+        if job is None or job.status not in (RUNNING,):
+            return
+        job.status = status
+        M.counter("fleet.lane_retires", reason=reason).inc()
+        self.server.update_lane_gauge()
+
+    def cancel_lane(self, lane: int) -> None:
+        """Freeze the lane (bits of every other lane untouched) and
+        drop its in-flight rows."""
+        self.carry = ISO.retire_lanes(
+            self.carry, np.arange(self.B) == lane)
+        self.left_h[lane] = 0
+        self.guard.epochs[lane] += 1
+        self.retire(lane, CANCELLED, "cancelled")
+
+    def lane_state(self, lane: int) -> Dict[str, np.ndarray]:
+        """Host copies of one lane's carry leaves (tests, summaries)."""
+        return {k: np.asarray(v[lane]) for k, v in self.carry.items()}
+
+    def running_lanes(self) -> int:
+        return sum(
+            1 for j in self.jobs if j is not None and j.status == RUNNING)
+
+
+#: weakrefs of live servers, for the obs /health payload
+_LIVE: List["weakref.ReferenceType[FleetServer]"] = []
+
+
+def live_servers() -> List["FleetServer"]:
+    out = []
+    for ref in list(_LIVE):
+        srv = ref()
+        if srv is None:
+            _LIVE.remove(ref)
+        else:
+            out.append(srv)
+    return out
+
+
+class FleetServer:
+    """The multi-tenant front door: queue, assembly, dispatch, fan-out."""
+
+    def __init__(self, max_lanes: Optional[int] = None,
+                 max_buckets: Optional[int] = None,
+                 snap_every: Optional[int] = None,
+                 max_retries: Optional[int] = None,
+                 workdir: Optional[str] = None):
+        self.max_lanes = int(
+            max_lanes if max_lanes is not None
+            else _env_int("CUP3D_FLEET_LANES", 64))
+        self.max_buckets = int(
+            max_buckets if max_buckets is not None
+            else _env_int("CUP3D_FLEET_BUCKETS", 8))
+        snap_steps = (
+            snap_every if snap_every is not None
+            else _env_int("CUP3D_SNAP_EVERY", 16))
+        self.snap_steps = max(1, int(snap_steps))
+        self.max_retries = max_retries
+        self.workdir = workdir or tempfile.mkdtemp(prefix="cup3d-fleet-")
+        self._jobs: "OrderedDict[str, FleetJob]" = OrderedDict()
+        self._execs: "OrderedDict[tuple, object]" = OrderedDict()
+        self.batches: List[FleetBatch] = []
+        self._next_job = 0
+        self._next_batch = 0
+        self.mesh = FB.fleet_mesh()
+        _LIVE.append(weakref.ref(self))
+
+    # -- tenant lifecycle --------------------------------------------------
+
+    def submit(self, tenant: str, spec: dict) -> str:
+        """Validate + enqueue one scenario; returns the job id."""
+        kind = str(spec.get("kind", "fish"))
+        if kind not in ("fish", "tgv"):
+            raise ValueError(f"unknown fleet scenario kind {kind!r}")
+        if int(spec.get("nsteps", 0)) <= 0:
+            raise ValueError("fleet scenario needs nsteps > 0")
+        job_id = f"job-{self._next_job:04d}"
+        self._next_job += 1
+        job = FleetJob(job_id=job_id, tenant=str(tenant), spec=dict(spec),
+                       nsteps=int(spec["nsteps"]))
+        self._jobs[job_id] = job
+        M.counter("fleet.submits").inc()
+        return job_id
+
+    def poll(self, job_id: str) -> dict:
+        return self._jobs[job_id].summary()
+
+    def cancel(self, job_id: str) -> bool:
+        """Cancel a queued or running job; terminal jobs are left
+        alone.  Returns True when the job state changed."""
+        job = self._jobs[job_id]
+        if job.status == QUEUED:
+            job.status = CANCELLED
+            M.counter("fleet.lane_retires", reason="cancelled").inc()
+            return True
+        if job.status == RUNNING and job.batch is not None:
+            job.batch.cancel_lane(job.lane)
+            return True
+        return False
+
+    def drain(self) -> Dict[str, dict]:
+        """Assemble everything queued and run the dispatch loop (round-
+        robin over batches) until every lane is terminal.  Returns the
+        per-tenant summary."""
+        self.assemble()
+        while True:
+            live = [b for b in self.batches if b.active()]
+            if not live:
+                break
+            for b in live:
+                b.tick()
+        for b in self.batches:
+            b.settle()
+        self.update_lane_gauge()
+        return self.tenant_summary()
+
+    # -- assembly ----------------------------------------------------------
+
+    def lane_capacity(self, njobs: int) -> int:
+        """Lane-count ladder rung for a batch of ``njobs``, clamped to
+        the max-lanes knob and rounded to the mesh multiple."""
+        cap = min(
+            count_capacity(njobs, base=LANE_LADDER_BASE), self.max_lanes)
+        cap = max(cap, njobs)
+        mult = FB.mesh_lane_multiple(self.mesh)
+        if cap % mult:
+            cap += mult - cap % mult
+        return cap
+
+    def assemble(self) -> List[FleetBatch]:
+        """Queued jobs -> bucketed batches.  Buckets key on the static
+        signature plus the ×1.25 step-budget rung; each bucket splits
+        into chunks of <= max_lanes and pads up the lane ladder."""
+        queued = [j for j in self._jobs.values() if j.status == QUEUED]
+        if not queued:
+            return []
+        built = []
+        buckets: "OrderedDict[tuple, list]" = OrderedDict()
+        for job in queued:
+            kind, cfg = _job_config(job.spec, self.workdir)
+            job.cfg = cfg
+            from cup3d_tpu.sim.simulation import Simulation
+
+            drv = Simulation(cfg)
+            drv.init()
+            if not drv._megaloop_eligible():
+                job.status = FAILED
+                job.error = "scenario not scan-eligible"
+                M.counter("fleet.lane_retires", reason="ineligible").inc()
+                continue
+            key = (_static_signature(drv, kind),
+                   count_capacity(job.nsteps, base=1))
+            buckets.setdefault(key, []).append((kind, job, drv))
+        for (sig, _rung), members in buckets.items():
+            for i in range(0, len(members), self.max_lanes):
+                chunk = members[i:i + self.max_lanes]
+                kind = chunk[0][0]
+                jobs = [job for _, job, _ in chunk]
+                drivers = [drv for _, _, drv in chunk]
+                K = resolve_scan_k(drivers[0].cfg)
+                if K <= 1:
+                    K = DEFAULT_SCAN_K
+                b = FleetBatch(self, self._next_batch, kind, jobs,
+                               drivers, K, self.lane_capacity(len(jobs)))
+                self._next_batch += 1
+                self.batches.append(b)
+                built.append(b)
+        self.update_lane_gauge()
+        return built
+
+    def executable(self, sig: tuple, s, ob, cap: int, K: int):
+        """The compiled-advance cache, LRU-capped by the buckets knob:
+        one vmapped executable per (signature, lane rung, K)."""
+        key = (sig, int(cap), int(K))
+        hit = self._execs.pop(key, None)
+        if hit is not None:
+            self._execs[key] = hit
+            M.counter("fleet.executable_hits").inc()
+            return hit
+        fn = FB.build_fleet_advance(s, ob, mesh=self.mesh)
+        self._execs[key] = fn
+        M.counter("fleet.executable_builds").inc()
+        while len(self._execs) > self.max_buckets:
+            self._execs.popitem(last=False)
+            M.counter("fleet.executable_evictions").inc()
+        return fn
+
+    # -- observability -----------------------------------------------------
+
+    def update_lane_gauge(self) -> None:
+        M.gauge("fleet.lanes_active").set(
+            float(sum(b.running_lanes() for b in self.batches)))
+
+    def jobs_by_status(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for job in self._jobs.values():
+            out[job.status] = out.get(job.status, 0) + 1
+        return out
+
+    def tenant_summary(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for job in self._jobs.values():
+            t = out.setdefault(
+                job.tenant, {"jobs": [], "steps_done": 0, "statuses": {}})
+            t["jobs"].append(job.summary())
+            t["steps_done"] += int(job.steps_done)
+            st = t["statuses"]
+            st[job.status] = st.get(job.status, 0) + 1
+        return out
+
+    def lane_state(self, job_id: str) -> Dict[str, np.ndarray]:
+        job = self._jobs[job_id]
+        if job.batch is None:
+            raise ValueError(f"{job_id} was never assembled into a batch")
+        return job.batch.lane_state(job.lane)
+
+    def health(self) -> dict:
+        """Fleet state for the obs /health endpoint."""
+        return {
+            "jobs": self.jobs_by_status(),
+            "lanes_active": int(
+                sum(b.running_lanes() for b in self.batches)),
+            "batches": len(self.batches),
+            "dispatches": int(sum(b.dispatches for b in self.batches)),
+            "rollbacks": int(sum(b.guard.rollbacks for b in self.batches)),
+            "executables": len(self._execs),
+            "knobs": {
+                "max_lanes": self.max_lanes,
+                "max_buckets": self.max_buckets,
+                "snap_steps": self.snap_steps,
+                "mesh": (int(self.mesh.devices.size)
+                         if self.mesh is not None else 0),
+            },
+        }
+
+
+def summary_json(summary: Dict[str, dict]) -> str:
+    return json.dumps(summary, indent=2, sort_keys=True)

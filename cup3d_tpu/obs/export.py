@@ -144,12 +144,19 @@ def health_payload() -> dict:
             "recovery_events": len(fr.recovery_events),
         })
     counters = {k: v for k, v in snap.items()
-                if k.startswith(("flight.", "resilience.", "recovery."))}
+                if k.startswith(("flight.", "resilience.", "recovery.",
+                                 "fleet."))}
+    # live fleet servers (weakref registry, same pattern as the flight
+    # recorders); the lazy import keeps obs importable standalone
+    from cup3d_tpu.fleet.server import live_servers as _fleet_live
+
+    fleet = [srv.health() for srv in _fleet_live()]
     return {
         "status": "ok",
         "time": time.time(),
         "flight_recorders": flights,
         "recovery_counters": counters,
+        "fleet": fleet,
         "trace": {"enabled": _trace.TRACE.enabled,
                   "steps_recorded": _trace.TRACE.steps_recorded,
                   "steps_dropped": _trace.TRACE.steps_dropped},

@@ -59,6 +59,14 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("detail", "wall_per_step_p95_s")),
         higher_is_better=False,
     ),
+    # fleet serving throughput: aggregate useful cells/s over all lanes
+    # of the fleet32 config (bench.py), direction-aware higher-is-better
+    MetricSpec(
+        "fleet_cells_per_s",
+        (("fleet32", "fleet_cells_per_s"),
+         ("detail", "fleet_cells_per_s")),
+        higher_is_better=True,
+    ),
 )
 
 

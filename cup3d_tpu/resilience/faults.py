@@ -50,6 +50,10 @@ SITES = (
     "ckpt.write_fail",
     "dump.write_fail",
     "stream.stall",
+    # lane-addressed fleet seam: armed with the LANE index in the step
+    # slot, it poisons exactly one chosen lane's QoI chain at its next
+    # consumed row (fleet/isolate.py check_row)
+    "fleet.lane_nan",
 )
 
 ENV_VAR = "CUP3D_FAULT"

@@ -132,9 +132,13 @@ def init_fish_carry(s, ob):
     }
 
 
-def build_tgv_megaloop(s):
-    """jitted (carry, cfl_eff (K,)) -> (carry', rows (K, TGV_ROW)) for the
-    obstacle-free uniform pipeline.  The carry is DONATED."""
+def make_tgv_step(s):
+    """The obstacle-free scan body as a pure function
+    ``one_step(carry, cfl_eff) -> (carry', row (TGV_ROW,))``.  All grid /
+    solver / uinf statics are frozen in the closure; the function has no
+    leading batch axis, so fleet/batch.py can ``vmap`` it over a scenario
+    axis unchanged (the lane independence the fleet isolation contract
+    relies on: no cross-lane reduction anywhere in the body)."""
     grid, nu, dtype = s.grid, s.nu, s.dtype
     h = float(grid.h)
     solver = s.poisson_solver
@@ -163,24 +167,31 @@ def build_tgv_megaloop(s):
                                time_new[None]])
         return out, row
 
+    return one_step
+
+
+def build_tgv_megaloop(s):
+    """jitted (carry, cfl_eff (K,)) -> (carry', rows (K, TGV_ROW)) for the
+    obstacle-free uniform pipeline.  The carry is DONATED."""
+    one_step = make_tgv_step(s)
+
     def megaloop(carry, cfl_eff):
         return jax.lax.scan(one_step, carry, cfl_eff)
 
     return jax.jit(megaloop, donate_argnums=(0,))
 
 
-def build_fish_megaloop(s, ob):
-    """jitted (carry, cfl_eff (K,)) -> (carry', rows (K, FISH_ROW)) for the
-    single-StefanFish uniform pipeline.  Returns None when the gait is not
-    freezable (models/fish/device_midline.freeze_gait).  The carry is
-    DONATED.
+def make_fish_step(s, ob):
+    """The single-StefanFish scan body as a pure function
+    ``one_step(gait, carry, cfl_eff) -> (carry', row (FISH_ROW,))``.
 
     Everything geometric is frozen static at build time: the rasterization
     window, the probe window + slot budget (obstacle_probe_budget
     hysteresis is deliberately frozen for the megaloop's lifetime so
-    steady swimming never retraces), the forced/blocked masks, and the
-    gait parameters."""
-    from cup3d_tpu.models.fish.device_midline import freeze_gait
+    steady swimming never retraces), and the forced/blocked masks.  The
+    frozen-gait parameters are an ARGUMENT pytree rather than a closure,
+    so the solo megaloop can bake one gait in as trace-time constants
+    while fleet/batch.py stacks per-lane gaits and vmaps over them."""
     from cup3d_tpu.models.fish.rasterize import rasterize_midline
     from cup3d_tpu.ops.surface import (
         _uniform_window_probe,
@@ -193,9 +204,6 @@ def build_fish_megaloop(s, ob):
     h = float(grid.h)
     solver = s.poisson_solver
     with_stats = bool(getattr(solver, "supports_stats", False))
-    gait = freeze_gait(ob, s.time, dtype)
-    if gait is None:
-        return None
 
     n = np.asarray(grid.shape)
     grid_shape = tuple(int(v) for v in n)
@@ -219,7 +227,7 @@ def build_fish_megaloop(s, ob):
 
     from cup3d_tpu.models.fish.device_midline import midline_state_device
 
-    def one_step(carry, cfl_eff):
+    def one_step(gait, carry, cfl_eff):
         vel, p = carry["vel"], carry["p"]
         rigid, qint = carry["rigid"], carry["qint"]
         umax, time, dtprev = carry["umax"], carry["time"], carry["dt"]
@@ -292,7 +300,25 @@ def build_fish_megaloop(s, ob):
                                umax_new[None], dt[None], time_new[None]])
         return carry_new, row
 
+    return one_step
+
+
+def build_fish_megaloop(s, ob):
+    """jitted (carry, cfl_eff (K,)) -> (carry', rows (K, FISH_ROW)) for the
+    single-StefanFish uniform pipeline.  Returns None when the gait is not
+    freezable (models/fish/device_midline.freeze_gait).  The carry is
+    DONATED.  The frozen gait is bound here as trace-time constants (the
+    same leaves the closure used to capture), so the compiled artifact is
+    unchanged by the make_fish_step refactor."""
+    from cup3d_tpu.models.fish.device_midline import freeze_gait
+
+    gait = freeze_gait(ob, s.time, s.dtype)
+    if gait is None:
+        return None
+    one_step = make_fish_step(s, ob)
+
     def megaloop(carry, cfl_eff):
-        return jax.lax.scan(one_step, carry, cfl_eff)
+        return jax.lax.scan(
+            lambda c, x: one_step(gait, c, x), carry, cfl_eff)
 
     return jax.jit(megaloop, donate_argnums=(0,))

@@ -517,6 +517,72 @@ def test_jx012_obs_channel_use_is_clean():
     assert not any(v.rule == "JX012" for v in _failing(src))
 
 
+def test_jx013_lane_loop_fires_suppresses_and_scopes():
+    """Per-lane device dispatch inside a scenario-axis loop in fleet/
+    (round 14): B lanes exist to be advanced by ONE vmapped dispatch;
+    a per-lane device loop pays the host overhead B times over."""
+    FLEET = "cup3d_tpu/fleet/fixture.py"
+    src = (
+        "import jax.numpy as jnp\n"
+        "class Batch:\n"
+        "    def fixup(self):\n"
+        "        for lane in range(self.nlanes):\n"
+        "            self.carry[lane] = jnp.where(self.mask, 0.0, 1.0)\n"
+    )
+    vs = _failing(src, FLEET)
+    assert _rules(vs) == {"JX013"}
+    assert "vectorize" in vs[0].message
+    # comprehensions over the lane axis fire too
+    comp = (
+        "import jax.numpy as jnp\n"
+        "def kes(lane_carries):\n"
+        "    return [jnp.sum(c) for c in lane_carries]\n"
+    )
+    assert _rules(_failing(comp, FLEET)) == {"JX013"}
+    # jitwrapper-convention calls (self._advance(...)) count as device
+    wrap = (
+        "class Batch:\n"
+        "    def run(self):\n"
+        "        for lane in range(self.nlanes):\n"
+        "            self.carry = self._advance(self.carry, lane)\n"
+    )
+    assert _rules(_failing(wrap, FLEET)) == {"JX013"}
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "            self.carry[lane]",
+        "            # jax-lint: allow(JX013, one-off debug dump, not a\n"
+        "            # dispatch path)\n"
+        "            self.carry[lane]",
+    )
+    all_vs = L.lint_source(ok, FLEET)
+    assert not L.failing(all_vs)
+    assert any(v.rule == "JX013" and "debug dump" in
+               (v.suppression_reason or "") for v in all_vs)
+    # scoped to fleet/: the same loop elsewhere is other rules' business
+    assert not any(v.rule == "JX013" for v in _failing(src, HOT))
+
+
+def test_jx013_host_only_lane_loops_are_clean():
+    """Assembly and fan-out loops touch no device value — never fire;
+    nor do device calls in loops over non-axis names."""
+    FLEET = "cup3d_tpu/fleet/fixture.py"
+    host = (
+        "import numpy as np\n"
+        "class Batch:\n"
+        "    def fanout(self):\n"
+        "        for lane, job in enumerate(self.jobs):\n"
+        "            job.record(lane, np.asarray(self.rows[lane]))\n"
+    )
+    assert not any(v.rule == "JX013" for v in _failing(host, FLEET))
+    other_axis = (
+        "import jax.numpy as jnp\n"
+        "def pad(blocks):\n"
+        "    return [jnp.zeros(3) for _ in range(len(blocks))]\n"
+    )
+    assert not any(v.rule == "JX013"
+                   for v in _failing(other_axis, FLEET))
+
+
 def test_wrapped_annotation_comment_blocks_parse():
     """A multi-line (wrapped) annotation applies to the next code line."""
     src = (

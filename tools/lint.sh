@@ -1,8 +1,8 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX012
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX013
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
-# swallowed-exception, JX011 bf16-reduction-accumulator and JX012
-# profiler-outside-obs rules)
+# swallowed-exception, JX011 bf16-reduction-accumulator, JX012
+# profiler-outside-obs and JX013 per-lane-loop rules)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
 # + the obs trace schema selftest (tools/trace_check.py), the
 # device-attribution parser selftest (obs/profile.py), the bench-
@@ -45,6 +45,12 @@ python -m cup3d_tpu.analysis --rules JX011 cup3d_tpu/ops -q
 # jax.profiler use outside obs/ fails CI identifiably
 echo "== python -m cup3d_tpu.analysis --rules JX012 $PATHS"
 python -m cup3d_tpu.analysis --rules JX012 $PATHS -q
+
+# the per-lane-loop rule on its own line (round 14): a Python loop over
+# the scenario axis dispatching device work in fleet/ fails CI
+# identifiably — the lane axis must stay vectorized (vmap)
+echo "== python -m cup3d_tpu.analysis --rules JX013 cup3d_tpu/fleet"
+python -m cup3d_tpu.analysis --rules JX013 cup3d_tpu/fleet -q
 
 # fused-kernel smoke (round 12): the interpret-mode selftest exercises
 # every Pallas stage of the fused BiCGSTAB driver without a TPU

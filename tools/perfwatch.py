@@ -65,29 +65,31 @@ def selftest() -> None:
     slowdown fires on every tracked metric, and the gate trips."""
     import tempfile
 
-    def mk(cells, iter_ms, p95):
+    def mk(cells, iter_ms, p95, fleet):
         return {"value": cells, "unit": "cells/s",
                 "fish": {"wall_per_step_p95_s": p95,
-                         "roofline": {"bicgstab_iter_device_ms": iter_ms}}}
+                         "roofline": {"bicgstab_iter_device_ms": iter_ms}},
+                "fleet32": {"fleet_cells_per_s": fleet}}
 
     with tempfile.TemporaryDirectory() as td:
         store = obs_history.HistoryStore(os.path.join(td, "hist.jsonl"))
         # ±2-3% run noise around a stable baseline
-        for cells, ms, p95 in ((1.00e6, 2.00, 0.100),
-                               (1.02e6, 1.97, 0.098),
-                               (0.98e6, 2.03, 0.102),
-                               (1.01e6, 2.01, 0.101),
-                               (0.99e6, 1.99, 0.099)):
-            store.append(mk(cells, ms, p95))
+        for cells, ms, p95, fleet in ((1.00e6, 2.00, 0.100, 8.0e6),
+                                      (1.02e6, 1.97, 0.098, 8.2e6),
+                                      (0.98e6, 2.03, 0.102, 7.9e6),
+                                      (1.01e6, 2.01, 0.101, 8.1e6),
+                                      (0.99e6, 1.99, 0.099, 8.0e6)):
+            store.append(mk(cells, ms, p95, fleet))
         assert len(store.load()) >= 2, "history store must accumulate"
         reports = obs_history.detect_regressions(store.summaries())
         assert not obs_history.any_regressed(reports), reports
-        # an injected 20% slowdown fires on all three metrics
-        store.append(mk(0.80e6, 2.40, 0.120))
+        # an injected 20% slowdown fires on every tracked metric
+        # (fleet_cells_per_s is direction-aware: a DROP regresses)
+        store.append(mk(0.80e6, 2.40, 0.120, 6.4e6))
         reports = obs_history.detect_regressions(store.summaries())
         by = {r["metric"]: r for r in reports}
         for name in ("cells_per_s", "bicgstab_iter_device_ms",
-                     "wall_per_step_p95_s"):
+                     "wall_per_step_p95_s", "fleet_cells_per_s"):
             assert by[name]["regressed"], (name, by[name])
         # a malformed line is skipped, not fatal
         with open(store.path, "a") as f:
