@@ -53,6 +53,25 @@ from cup3d_tpu.sim.megaloop import (  # noqa: F401  (rows re-exported)
 LEFT = "left"
 
 
+def init_amr_carry(s):
+    """Obstacle-free bucketed-AMR lane carry: the (capacity, 8, 8, 8)
+    padded vel/p forest plus the (umax, time, dt) chain — same keys as
+    init_tgv_carry, so stack_carries/the gated body treat adaptive and
+    uniform lanes identically.  umax is measured with the mega_free
+    convention (max |vel + uinf| over the padded forest; padding rows
+    are zero, so they never win the max)."""
+    dtype = s.dtype
+    uinf = s.uinf_device()
+    vel = s.state["vel"]
+    return {
+        "vel": vel,
+        "p": s.state["p"],
+        "umax": jnp.max(jnp.abs(vel + uinf)),
+        "time": jnp.asarray(s.time, dtype),
+        "dt": jnp.asarray(s.dt, dtype),
+    }
+
+
 def stack_gaits(gaits, dtype):
     """Per-lane frozen-gait dicts -> one batched pytree (leading lane
     axis).  Python-float leaves become (B,) device scalars so vmap can
@@ -138,13 +157,18 @@ def mesh_lane_multiple(mesh) -> int:
     return int(mesh.devices.size) if mesh is not None else 1
 
 
-def build_fleet_advance(s, ob=None, mesh=None):
+def build_fleet_advance(s, ob=None, mesh=None, kind=None):
     """jitted ``(carry_B, cfl (B, K), gaits_B) -> (carry_B', rows
     (B, K, ROW))``: B independent lanes, K steps each, one dispatch.
 
     ``s`` is the bucket's template Simulation (grid, solver, statics);
-    ``ob`` its template obstacle for the fish pipeline (None selects the
-    obstacle-free body, where ``gaits`` is passed as None).  With a
+    ``ob`` its template obstacle for the fish pipeline (None selects an
+    obstacle-free body, where ``gaits`` is passed as None).  ``kind``
+    picks the scan body explicitly — "fish", "tgv", or "amr_tgv" (the
+    bucketed block-forest body from sim/amr.make_amr_tgv_step, whose
+    frozen padded-topology closure is what fleet/server.py's
+    (capacity, topology-signature) bucket key guarantees is shared) —
+    defaulting to fish/tgv by ``ob`` for older callers.  With a
     ``mesh`` the lane axis is sharded across devices via the
     parallel/compat.py shard_map wrapper — the body is collective-free,
     so each device runs the vmapped advance over its lane shard.
@@ -153,8 +177,17 @@ def build_fleet_advance(s, ob=None, mesh=None):
     feeds lane-wise where-selects against the previous carry on the
     rollback path (fleet/isolate.py), so the pre-dispatch buffers must
     stay valid until the isolation layer releases them."""
-    has_gait = ob is not None
-    core = make_fish_step(s, ob) if has_gait else make_tgv_step(s)
+    if kind is None:
+        kind = "fish" if ob is not None else "tgv"
+    has_gait = kind == "fish"
+    if kind == "fish":
+        core = make_fish_step(s, ob)
+    elif kind == "amr_tgv":
+        from cup3d_tpu.sim.amr import make_amr_tgv_step
+
+        core = make_amr_tgv_step(s)
+    else:
+        core = make_tgv_step(s)
     body = _gated(core, has_gait)
 
     def lane_scan(gait, carry, cfl_eff):

@@ -120,17 +120,17 @@ class FleetJob:
 
 
 def _job_config(spec: dict, workdir: str) -> Tuple[str, SimulationConfig]:
-    """Scenario spec -> (kind, SimulationConfig) for one uniform
-    pipelined lane.  Only scan-eligible configs are expressible: free
-    dt, step-budget termination, <= 1 frozen-gait obstacle."""
+    """Scenario spec -> (kind, SimulationConfig) for one pipelined
+    lane.  Only scan-eligible configs are expressible: free dt,
+    step-budget termination, <= 1 frozen-gait obstacle; "amr_tgv"
+    lanes run the bucketed block-forest body on a topology frozen
+    after init (see _AMRLaneDriver)."""
     kind = str(spec.get("kind", "fish"))
     nsteps = int(spec.get("nsteps", 0))
     if nsteps <= 0:
         raise ValueError("fleet scenario needs nsteps > 0")
     n = int(spec.get("n", 32))
     common = dict(
-        bpdx=1, bpdy=1, bpdz=1, block_size=n,
-        levelMax=1, levelStart=0,
         nsteps=nsteps, tend=0.0,
         CFL=float(spec.get("cfl", 0.3)),
         rampup=int(spec.get("rampup", 0)),
@@ -138,11 +138,29 @@ def _job_config(spec: dict, workdir: str) -> Tuple[str, SimulationConfig]:
         pipelined=True, verbose=False, freqDiagnostics=0,
         path4serialization=workdir,
     )
+    uniform = dict(
+        bpdx=1, bpdy=1, bpdz=1, block_size=n,
+        levelMax=1, levelStart=0,
+    )
     if kind == "tgv":
         cfg = SimulationConfig(
             extent=float(spec.get("extent", 2.0 * np.pi)),
             nu=float(spec.get("nu", 0.02)),
             initCond=str(spec.get("initCond", "taylorGreen")),
+            **uniform, **common,
+        )
+    elif kind == "amr_tgv":
+        bpd = int(spec.get("bpd", 2))
+        lm = int(spec.get("levelMax", 2))
+        cfg = SimulationConfig(
+            bpdx=bpd, bpdy=bpd, bpdz=bpd,
+            levelMax=lm, levelStart=int(spec.get("levelStart", lm - 1)),
+            Rtol=float(spec.get("rtol", 1e9)),
+            Ctol=float(spec.get("ctol", -1.0)),
+            extent=float(spec.get("extent", 2.0 * np.pi)),
+            nu=float(spec.get("nu", 0.02)),
+            initCond=str(spec.get("initCond", "taylorGreen")),
+            step_2nd_start=int(spec.get("step_2nd_start", 0)),
             **common,
         )
     elif kind == "fish":
@@ -157,17 +175,55 @@ def _job_config(spec: dict, workdir: str) -> Tuple[str, SimulationConfig]:
             extent=float(spec.get("extent", 1.0)),
             nu=float(spec.get("nu", 1e-4)),
             factory_content=factory,
-            **common,
+            **uniform, **common,
         )
     else:
         raise ValueError(f"unknown fleet scenario kind {kind!r}")
     return kind, cfg
 
 
+class _AMRLaneDriver:
+    """Adapter giving an obstacle-free AMRSimulation the driver surface
+    assemble()/FleetBatch expect (.sim/.cfg/init/_megaloop_eligible).
+    init runs the usual 3*levelMax adaptation rounds, then FREEZES the
+    topology: the fleet scan body never regrids, so every lane keeps
+    the (capacity, topology-signature) it bucketed on for the whole
+    drain — the zero-retrace contract inside a bucket."""
+
+    def __init__(self, sim):
+        self.sim = sim
+        self.cfg = sim.cfg
+
+    def init(self):
+        self.sim.init()
+        self.sim.adapt_enabled = False
+
+    def _megaloop_eligible(self) -> bool:
+        s, cfg = self.sim, self.cfg
+        return (not s.obstacles and s.forest is None and s._bucketing
+                and not cfg.implicitDiffusion and not cfg.bFixMassFlux
+                and cfg.uMax_forced <= 0)
+
+
 def _static_signature(drv, kind: str) -> tuple:
     """Everything that changes the compiled lane body: jobs sharing a
-    signature (and a lane/step rung) share one executable."""
+    signature (and a lane/step rung) share one executable.  Adaptive
+    tenants key on (capacity, octree topology-signature): equal keys
+    <=> the vmapped bucketed step's compiled shapes AND its frozen
+    padded tables match, so lanes can share the closure-captured
+    geometry bundle without retracing."""
     s = drv.sim
+    if kind == "amr_tgv":
+        return (
+            kind,
+            int(s.grid.bs),
+            int(s._cap),
+            s.grid.signature,
+            str(np.dtype(s.dtype)),
+            float(s.nu),
+            tuple(float(v) for v in s.grid.extent),
+            int(drv.cfg.step_2nd_start),
+        )
     sig = (
         kind,
         tuple(int(v) for v in np.asarray(s.grid.shape)),
@@ -228,6 +284,8 @@ class FleetBatch:
                         f"{job.job_id}: gait not freezable for fleet")
                 gaits.append(gait)
                 carries.append(FB.init_fish_carry(drv.sim, ob))
+            elif kind == "amr_tgv":
+                carries.append(FB.init_amr_carry(drv.sim))
             else:
                 carries.append(FB.init_tgv_carry(drv.sim))
             targets.append(job.nsteps)
@@ -248,7 +306,8 @@ class FleetBatch:
         self.gaits = FB.stack_gaits(gaits, s.dtype) if gaits else None
         ob = s.obstacles[0] if kind == "fish" else None
         self.advance = server.executable(
-            _static_signature(template, kind), s, ob, self.B, self.K)
+            _static_signature(template, kind), s, ob, self.B, self.K,
+            kind=kind)
 
         self.step_h = np.zeros(self.B, np.int64)
         self.left_h = np.asarray(targets, np.int64)
@@ -446,7 +505,7 @@ class FleetServer:
     def submit(self, tenant: str, spec: dict) -> str:
         """Validate + enqueue one scenario; returns the job id."""
         kind = str(spec.get("kind", "fish"))
-        if kind not in ("fish", "tgv"):
+        if kind not in ("fish", "tgv", "amr_tgv"):
             raise ValueError(f"unknown fleet scenario kind {kind!r}")
         if int(spec.get("nsteps", 0)) <= 0:
             raise ValueError("fleet scenario needs nsteps > 0")
@@ -515,9 +574,14 @@ class FleetServer:
         for job in queued:
             kind, cfg = _job_config(job.spec, self.workdir)
             job.cfg = cfg
-            from cup3d_tpu.sim.simulation import Simulation
+            if kind == "amr_tgv":
+                from cup3d_tpu.sim.amr import AMRSimulation
 
-            drv = Simulation(cfg)
+                drv = _AMRLaneDriver(AMRSimulation(cfg))
+            else:
+                from cup3d_tpu.sim.simulation import Simulation
+
+                drv = Simulation(cfg)
             drv.init()
             if not drv._megaloop_eligible():
                 job.status = FAILED
@@ -544,7 +608,8 @@ class FleetServer:
         self.update_lane_gauge()
         return built
 
-    def executable(self, sig: tuple, s, ob, cap: int, K: int):
+    def executable(self, sig: tuple, s, ob, cap: int, K: int,
+                   kind: Optional[str] = None):
         """The compiled-advance cache, LRU-capped by the buckets knob:
         one vmapped executable per (signature, lane rung, K)."""
         key = (sig, int(cap), int(K))
@@ -553,7 +618,7 @@ class FleetServer:
             self._execs[key] = hit
             M.counter("fleet.executable_hits").inc()
             return hit
-        fn = FB.build_fleet_advance(s, ob, mesh=self.mesh)
+        fn = FB.build_fleet_advance(s, ob, mesh=self.mesh, kind=kind)
         self._execs[key] = fn
         M.counter("fleet.executable_builds").inc()
         while len(self._execs) > self.max_buckets:

@@ -63,6 +63,47 @@ def tag_states(
     return states
 
 
+def device_tags(
+    vort: jnp.ndarray,
+    near: jnp.ndarray,
+    level: jnp.ndarray,
+    rtol: float,
+    ctol: float,
+    level_max: int,
+    level_max_vort: int,
+    chi_inf: bool,
+) -> jnp.ndarray:
+    """Jitted mirror of tag_states: per-block int8 tag (1=R, -1=C, 0=L).
+
+    Inputs are per-slot arrays over the padded bucket: `vort` the
+    vorticity score, `near` the grad-chi mask, `level` the octree level
+    of each slot (padding slots carry level 0 and score 0, so they tag
+    'L').  Composition matches sim/amr.py adapt_mesh exactly: the
+    per-block level cap is levelMax-1 near the body and
+    levelMaxVorticity-1 away from it (always), while the force-refine
+    score -> inf near the body applies only under bAdaptChiGradient
+    (`chi_inf`).  Comparisons are strict and refine wins over coarsen,
+    matching tag_states' elif chain, so host and device tags agree
+    bitwise whenever rtol/ctol are exactly representable in the score
+    dtype.
+    """
+    score = vort.astype(jnp.float32)
+    nearb = near.astype(bool)
+    if chi_inf:
+        score = jnp.where(nearb, jnp.inf, score)
+    cap = jnp.where(nearb, level_max - 1, level_max_vort - 1)
+    refine = (score > rtol) & (level < cap)
+    coarsen = (score < ctol) & (level > 0)
+    return jnp.where(refine, 1, jnp.where(coarsen, -1, 0)).astype(jnp.int8)
+
+
+def states_from_tags(grid: BlockGrid, tags: np.ndarray) -> Dict[Key, str]:
+    """Decode device_tags output (host-side) into the {key: 'R'/'C'/'L'}
+    dict that valid_states/adapt consume."""
+    sym = {1: "R", -1: "C", 0: "L"}
+    return {key: sym[int(tags[s])] for s, key in enumerate(grid.keys)}
+
+
 def valid_states(tree: Octree, states: Dict[Key, str]) -> Dict[Key, str]:
     """Enforce refinement/compression legality (ValidStates,
     main.cpp:5330-5492):

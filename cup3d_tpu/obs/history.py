@@ -67,6 +67,20 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("detail", "fleet_cells_per_s")),
         higher_is_better=True,
     ),
+    # round 15 (fused AMR): the adaptive config's sustained throughput
+    # and the forest BiCGSTAB device iteration (fused when the dispatch
+    # gate is on, else the flat legacy number — same roofline block)
+    MetricSpec(
+        "amr_cells_per_s",
+        (("amr_tgv", "cells_per_s"),),
+        higher_is_better=True,
+    ),
+    MetricSpec(
+        "amr_bicgstab_iter_device_ms",
+        (("amr_tgv", "roofline", "fused", "bicgstab_iter_device_ms"),
+         ("amr_tgv", "roofline", "bicgstab_iter_device_ms")),
+        higher_is_better=False,
+    ),
 )
 
 

@@ -233,9 +233,12 @@ def build_amr_poisson_solver(
     ``krylov.use_coarse_correction``) — the resilience escalation ladder
     drops to tile-only getZ per driver, not per process.
 
-    This AMR front-end runs the unfused composition regardless of
-    CUP3D_FUSED (the fused lanes kernels assume the uniform x-major tile
-    layout); it still inherits the round-12 precision hygiene — getZ
+    This STATIC front-end runs the unfused composition regardless of
+    CUP3D_FUSED (it exists for direct/legacy use on unpadded forests);
+    the bucketed production path goes through
+    ``build_amr_poisson_solver_dynamic``, which dispatches the fused
+    Pallas iteration (ops/fused_amr_bicgstab.py) under CUP3D_FUSED.
+    It still inherits the round-12 precision hygiene — getZ
     tile solves accumulate in >= f32 for any storage dtype
     (ops/tilesolve.py, ops/precision.py) and the bicgstab breakdown
     threshold lives in the accumulation dtype.
@@ -388,8 +391,25 @@ def build_amr_poisson_solver_dynamic(
     ``graph`` (krylov.BlockGraph: enables the two-level preconditioner),
     and ``slot0`` (traced corner-block slot for the pinned-row modes —
     a dynamic index, so pin relocation across regrids never retraces).
-    The math is identical to the static builder's."""
+    The math is identical to the static builder's.
+
+    Under ``CUP3D_FUSED`` (precision.use_fused) the production pressure
+    configuration — mean removal (mode 2) with the exact getZ — routes
+    the iteration through the fused Pallas driver
+    (ops/fused_amr_bicgstab.py): same A/M composition, intermediates
+    fused into per-stage kernels with in-kernel dot partials, Krylov
+    storage in ``precision.krylov_dtype()``.  Equivalence to the legacy
+    composition is at matched residual targets, not bitwise (the
+    reduction trees differ) — tests/test_fused_amr.py pins the bound.
+    Pinned-row modes and the CUP3D_GETZ=cg ladder keep the legacy loop.
+    """
     from cup3d_tpu.ops import krylov
+    from cup3d_tpu.ops import precision as _precision
+
+    # read the env knobs at build time, like build_iterative_solver:
+    # tests rebuild the solver to flip paths, production builds once
+    fused_on = (_precision.use_fused() and mean_constraint == 2
+                and krylov.use_exact_getz())
 
     def solve(rhs, x0=None, tab_arg=None, flux_arg=None, rnorm_ref=None,
               geom=None, vol=None, pmask=None, graph=None, slot0=None,
@@ -438,10 +458,20 @@ def build_amr_poisson_solver_dynamic(
         b = b * pmask if pmask is not None else b
         if rnorm_ref is None:
             rnorm_ref = jnp.sqrt(jnp.sum(b * b, dtype=jnp.float32))
-        x, rnorm, k = krylov.bicgstab(
-            A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel,
-            maxiter=maxiter, rnorm_ref=rnorm_ref,
-        )
+        if fused_on:
+            from cup3d_tpu.ops import fused_amr_bicgstab as _fused
+
+            x, rnorm, k = _fused.fused_amr_bicgstab(
+                geom, b, tab=t, ftab=ft, vol=vol, graph=graph,
+                tol_abs=tol_abs, tol_rel=tol_rel, maxiter=maxiter,
+                rnorm_ref=rnorm_ref, x0=x0,
+                store_dtype=_precision.krylov_dtype(),
+            )
+        else:
+            x, rnorm, k = krylov.bicgstab(
+                A, b, M=M, x0=x0, tol_abs=tol_abs, tol_rel=tol_rel,
+                maxiter=maxiter, rnorm_ref=rnorm_ref,
+            )
         if mean_constraint == 2:
             x = x - wmean(x)
         x = x * pmask if pmask is not None else x

@@ -41,6 +41,7 @@ partition (no diffusion balancing needed).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, Optional, Tuple
@@ -63,6 +64,43 @@ def make_block_mesh(devices=None, axis: str = "b") -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (axis,))
+
+
+#: (octree signature, mesh device ids, axis) -> ShardedForest, LRU
+_FOREST_MEMO: "OrderedDict[tuple, ShardedForest]" = OrderedDict()
+_FOREST_MEMO_MAX = 4
+
+
+def cached_forest(grid: BlockGrid, mesh: Optional[Mesh] = None
+                  ) -> "ShardedForest":
+    """Signature-keyed ShardedForest memo (the sharded twin of
+    sim/amr.py's _table_memo discipline): a regrid that returns to a
+    previously-seen topology — the dominant ping-pong pattern of
+    adaptive runs — reuses the forest's host-derived gather/exchange
+    tables AND, through sim/amr.py's executable memo keyed on the same
+    signature, every compiled sharded step.  Two topologies with equal
+    signatures have bitwise-equal tables, so the reuse is exact; a
+    genuinely new topology still pays one setup + trace (its tables
+    are closure constants by design, see module doc)."""
+    if mesh is None:
+        mesh = make_block_mesh()
+    key = (
+        grid.signature,
+        tuple(int(d.id) for d in np.asarray(mesh.devices).flat),
+        tuple(mesh.axis_names),
+    )
+    forest = _FOREST_MEMO.pop(key, None)
+    from cup3d_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.counter(
+        "forest.memo_hits" if forest is not None else "forest.memo_misses"
+    ).inc()
+    if forest is None:
+        forest = ShardedForest(grid, mesh)
+    _FOREST_MEMO[key] = forest
+    while len(_FOREST_MEMO) > _FOREST_MEMO_MAX:
+        _FOREST_MEMO.popitem(last=False)
+    return forest
 
 
 class _Exchange:

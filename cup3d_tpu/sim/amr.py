@@ -70,6 +70,20 @@ from cup3d_tpu.resilience.recovery import SimulationFailure
 ADAPT_EVERY = 20  # reference cadence (main.cpp:15314)
 _EPS = 1e-6
 
+#: the complete per-topology executable bundle _rebuild assigns on the
+#: forest (mesh) path.  Snapshotting these under the octree signature
+#: and rebinding on a signature match is what makes within-signature
+#: regrids (the refine->coarsen->refine ping-pong) retrace-free: the
+#: closure-style sharded jits are only reusable for an IDENTICAL
+#: topology, and equal signatures guarantee bitwise-equal tables.
+_FOREST_EXEC_ATTRS = (
+    "forest", "_tab1", "_tab3", "_ftab", "_solver", "_vol", "_h_col",
+    "_xc", "_real_mask", "_geom", "_advdiff", "_project", "_project_2nd",
+    "_penalize", "_penal_force", "_ubody", "_divnorms", "_dissipation",
+    "_gradchi", "_omega_mag", "_scores", "_moments", "_maxu",
+    "_megastep", "_megastep_free", "_fix_flux", "_device_tags",
+)
+
 
 class _ArgGeom:
     """Duck-typed BlockGrid over the bucket-padded block axis whose
@@ -243,6 +257,9 @@ class AMRSimulation:
         # refinement scores dispatched one step EARLY in pipelined mode so
         # the device compute + transfer overlap the inter-step host work
         self._scores_prefetch = None
+        # bucketed path binds the on-device tag decision in
+        # _bind_bucket_executables; None = host tagging (forest/legacy)
+        self._device_tags = None
         # capacity bucketing (module doc): single-device regrids reuse
         # compiled executables while the padded table shapes stay inside
         # a bucket; CUP3D_BUCKET=0 restores the legacy retrace path
@@ -251,6 +268,10 @@ class AMRSimulation:
         )
         self._table_memo: Dict = {}   # octree signature -> padded bundle
         self._exec_cache: Dict = {}   # bucket key -> jitted executables
+        # octree signature -> the forest path's full executable bundle
+        # (closure-style jits can only be reused for an IDENTICAL
+        # topology, so the memo key is the signature, not the bucket)
+        self._forest_memo: Dict = {}
         self._solver_core = None
         # round-10 resilience: simulate() installs a RecoveryEngine here
         # (CUP3D_RECOVER=1, the default); the Poisson escalation ladder
@@ -360,12 +381,31 @@ class AMRSimulation:
     def _rebuild(self):
         if self.mesh is None and self._bucketing:
             return self._rebuild_bucketed()
+        # forest/legacy paths keep the host tagging decision
+        self._device_tags = None
         g = self.grid
         cfg = self.cfg
         if self.mesh is not None:
-            from cup3d_tpu.parallel.forest import ShardedForest
+            from cup3d_tpu.parallel.forest import cached_forest
 
-            self.forest = ShardedForest(g, self.mesh)
+            # within-signature regrids (the ping-pong A->B->A pattern)
+            # rebind the memoized executable bundle: zero retraces, zero
+            # table rebuilds (parallel/forest.py cached_forest shares
+            # the key discipline)
+            from cup3d_tpu.obs import metrics as obs_metrics
+
+            sig = g.signature
+            memo = self._forest_memo.pop(sig, None)
+            obs_metrics.counter(
+                "forest.exec_memo_hits" if memo is not None
+                else "forest.exec_memo_misses"
+            ).inc()
+            if memo is not None:
+                self._forest_memo[sig] = memo
+                for k, v in memo.items():
+                    setattr(self, k, v)
+                return
+            self.forest = cached_forest(g, self.mesh)
             geom = self.forest.geom
             # round 4: mesh mode runs the face-slab fast path too
             # (parallel/faces.py; falls back to per-ghost lab tables only
@@ -426,9 +466,11 @@ class AMRSimulation:
             # both paths).  Donated args are the step state buffers the
             # caller rebinds from the return value (JX002 burn-down).
             if self.forest is not None:
-                # jax-lint: allow(JX007, forest path retraces per regrid
-                # by design: its duck-typed sharded tables are not
-                # pytrees and per-shard scale is bounded (module doc))
+                # jax-lint: allow(JX007, forest path traces once per NEW
+                # octree signature: its duck-typed sharded tables are not
+                # pytrees, so the whole executable bundle is memoized by
+                # signature instead (_forest_memo; zero steady-state
+                # retraces across the regrid ping-pong))
                 jf = jax.jit(lambda *a: fn(*a, *bound),
                              donate_argnums=donate)
                 return jf
@@ -582,9 +624,18 @@ class AMRSimulation:
                 return vel.at[..., 0].add(delta * profile), u_msr
 
             # jax-lint: allow(JX007, closes over this layout's profile +
-            # vol_total; forest/legacy paths retrace per regrid by
-            # design (see jit_bound above))
+            # vol_total; a NEW forest topology traces once and joins the
+            # signature memo below; the legacy single-device path
+            # retraces per regrid as the bucketing equivalence baseline)
             self._fix_flux = jax.jit(fix_flux)
+
+        if self.mesh is not None:
+            self._forest_memo[sig] = {
+                k: getattr(self, k) for k in _FOREST_EXEC_ATTRS
+                if hasattr(self, k)
+            }
+            while len(self._forest_memo) > 4:
+                self._forest_memo.pop(next(iter(self._forest_memo)))
 
     # -- capacity-bucketed rebuild (the single-device production path) -----
 
@@ -633,6 +684,10 @@ class AMRSimulation:
                 slot0 = int(np.lexsort(
                     (g.ijk[:, 2], g.ijk[:, 1], g.ijk[:, 0])
                 )[0])
+            # per-slot octree level for the on-device regrid decision
+            # (padding slots carry level 0 -> device_tags emits 'L')
+            level = np.zeros(cap, np.int32)
+            level[: g.nb] = [k[0] for k in g.keys]
             memo = dict(
                 cap=cap,
                 tab1=pad_face_tables(g.face_tables(1), g, cap),
@@ -645,6 +700,7 @@ class AMRSimulation:
                 xc=jnp.asarray(xc, self.dtype),
                 mask=jnp.asarray(mask, self.dtype),
                 slot0=jnp.asarray(slot0, jnp.int32),
+                level=jnp.asarray(level),
             )
             self._table_memo[sig] = memo
             while len(self._table_memo) > 4:
@@ -658,6 +714,7 @@ class AMRSimulation:
         self._xc = memo["xc"]
         self._real_mask = memo["mask"]
         self._slot0_dev = memo["slot0"]
+        self._level_arr = memo["level"]
         self._h_col = jnp.reshape(self._h_arr, (self._cap, 1, 1, 1))
         if cfg.bFixMassFlux:
             eta = self._xc[..., 1] / g.extent[1]
@@ -837,6 +894,21 @@ class AMRSimulation:
 
         ex["scores"] = jax.jit(scores)
 
+        def tags(vel, chi, level, *geo):
+            # on-device regrid DECISION: scores -> per-slot int8 tag in
+            # one dispatch, so adapt_mesh downloads (cap,) bytes instead
+            # of two full score fields (grid/adapt.py device_tags)
+            g_ = geom_of(geo[3])
+            vort = amr_ops.vorticity_score(g_, vel, geo[0])
+            near = amr_ops.gradchi_mask(g_, chi, geo[0])
+            return ad.device_tags(
+                vort, near, level, cfg.Rtol, cfg.Ctol,
+                cfg.levelMax, cfg.levelMaxVorticity,
+                bool(cfg.bAdaptChiGradient),
+            )
+
+        ex["tags"] = jax.jit(tags)
+
         def moments(chis, vel, cms, *geo):
             vol, xc = geo[4], geo[5]
             return jnp.stack([
@@ -888,6 +960,10 @@ class AMRSimulation:
         self._gradchi = lambda chi: ex["gradchi"](chi, *geo())
         self._omega_mag = lambda vel: ex["omega_mag"](vel, *geo())
         self._scores = lambda vel, chi: ex["scores"](vel, chi, *geo())
+        self._device_tags = (
+            lambda vel, chi:
+            ex["tags"](vel, chi, self._level_arr, *geo())
+        )
         self._moments = (
             lambda chis, vel, cms: ex["moments"](chis, vel, cms, *geo())
         )
@@ -1098,9 +1174,10 @@ class AMRSimulation:
             rebinds from its outputs (JX002 burn-down)."""
             if self.forest is not None:
                 jits = [
-                    # jax-lint: allow(JX007, forest path retraces per
-                    # regrid by design (see _rebuild jit_bound); the
-                    # bucketed path caches via _build_megastep_bucketed)
+                    # jax-lint: allow(JX007, forest path traces once per
+                    # NEW octree signature and rides _forest_memo after
+                    # (see _rebuild jit_bound); the bucketed path caches
+                    # via _build_megastep_bucketed)
                     jax.jit(lambda *a, _so=so: fn(*a, *tabs,
                                                   second_order=_so),
                             donate_argnums=donate)
@@ -1473,21 +1550,29 @@ class AMRSimulation:
     def adapt_mesh(self):
         g = self.grid
         cfg = self.cfg
-        if self._scores_prefetch is not None:
-            packed, nb_at = self._scores_prefetch
-            self._scores_prefetch = None
-            if nb_at != g.nb:  # layout changed since dispatch: recompute
-                packed = None
-        else:
-            packed = None
-        if packed is None:
-            vort, near_body = self._scores(
-                self.state["vel"], self.state["chi"]
-            )
-        else:
-            vals = np.asarray(packed, np.float64)
+        pf, self._scores_prefetch = self._scores_prefetch, None
+        if pf is not None and pf[1] != g.nb:
+            pf = None  # layout changed since dispatch: recompute
+        if self._device_tags is not None:
+            # on-device decision (grid/adapt.py device_tags): the host
+            # downloads only (cap,) tags — or decodes them from the
+            # prefetch pack, where they ride as exact small floats
+            if pf is not None and pf[2] == "tags":
+                tags = np.rint(np.asarray(pf[0], np.float64))
+            else:
+                tags = np.asarray(self._device_tags(
+                    self.state["vel"], self.state["chi"]
+                ))
+            states = ad.states_from_tags(g, tags[: g.nb])
+            return self._apply_states(states)
+        if pf is not None and pf[2] == "scores":
+            vals = np.asarray(pf[0], np.float64)
             vort, near_body = vals[: vals.shape[0] // 2], (
                 vals[vals.shape[0] // 2:] > 0.5
+            )
+        else:
+            vort, near_body = self._scores(
+                self.state["vel"], self.state["chi"]
             )
         score = np.asarray(vort, np.float64)[: g.nb]
         near = np.asarray(near_body)[: g.nb]
@@ -2083,14 +2168,24 @@ class AMRSimulation:
             if self.adapt_enabled and (
                 nxt < 10 or nxt % ADAPT_EVERY == 0
             ):
-                # dispatch next step's refinement scores now: the compute
-                # and transfer overlap this step's pack read + host work
-                # (staged through the stream so its bytes are counted)
-                vort, near = self._scores(s["vel"], s["chi"])
-                packed = self._pack_reader.stage(jnp.concatenate(
-                    [vort.astype(self.dtype), near.astype(self.dtype)]
-                ))
-                self._scores_prefetch = (packed, self.grid.nb)
+                # dispatch next step's refinement decision now: the
+                # compute and transfer overlap this step's pack read +
+                # host work (staged through the stream so its bytes are
+                # counted).  Bucketed path ships (cap,) device tags;
+                # forest/legacy ships the raw score fields.
+                if self._device_tags is not None:
+                    t = self._device_tags(s["vel"], s["chi"])
+                    # -1/0/1 are exact in any float dtype
+                    packed = self._pack_reader.stage(t.astype(self.dtype))
+                    self._scores_prefetch = (packed, self.grid.nb, "tags")
+                else:
+                    vort, near = self._scores(s["vel"], s["chi"])
+                    packed = self._pack_reader.stage(jnp.concatenate(
+                        [vort.astype(self.dtype), near.astype(self.dtype)]
+                    ))
+                    self._scores_prefetch = (
+                        packed, self.grid.nb, "scores"
+                    )
         self._log_diagnostics()
         with self.profiler("SyncQoI"):
             npairs = n * (n - 1) // 2
@@ -2151,11 +2246,18 @@ class AMRSimulation:
             self._umax_dev = pack[-1]
             nxt = self.step_idx + 1
             if self.adapt_enabled and (nxt < 10 or nxt % ADAPT_EVERY == 0):
-                vort, near = self._scores(s["vel"], s["chi"])
-                packed = self._pack_reader.stage(jnp.concatenate(
-                    [vort.astype(self.dtype), near.astype(self.dtype)]
-                ))
-                self._scores_prefetch = (packed, self.grid.nb)
+                if self._device_tags is not None:
+                    t = self._device_tags(s["vel"], s["chi"])
+                    packed = self._pack_reader.stage(t.astype(self.dtype))
+                    self._scores_prefetch = (packed, self.grid.nb, "tags")
+                else:
+                    vort, near = self._scores(s["vel"], s["chi"])
+                    packed = self._pack_reader.stage(jnp.concatenate(
+                        [vort.astype(self.dtype), near.astype(self.dtype)]
+                    ))
+                    self._scores_prefetch = (
+                        packed, self.grid.nb, "scores"
+                    )
         self._log_diagnostics()
         with self.profiler("SyncQoI"):
             self._pack_reader.emit(
@@ -2417,3 +2519,52 @@ class AMRSimulation:
         finally:
             if eng is not None:
                 eng.uninstall()
+
+
+def make_amr_tgv_step(sim: "AMRSimulation"):
+    """The obstacle-free bucketed-AMR scan body as a pure function
+    ``one_step(carry, cfl_eff) -> (carry', row (TGV_ROW,))`` — the
+    block-forest twin of sim/megaloop.make_tgv_step, so fleet/batch.py
+    can ``vmap`` adaptive lanes exactly like uniform ones.
+
+    The padded topology bundle (_geo_args) is frozen in the closure:
+    every lane in a fleet bucket shares the template's (capacity,
+    octree-signature) tables, and the body never regrids — fleet AMR
+    tenants run on a frozen topology for the drain (fleet/server.py
+    keys assembly on the signature, so mixed topologies land in
+    different buckets).  The dt chain is the uniform policy on the
+    FINEST level's spacing (the binding CFL constraint on a forest);
+    no operation reduces across lanes, so the PR 9 isolation contract
+    (per-lane NaN containment, bitwise freeze) carries over unchanged.
+    """
+    geo = sim._geo_args()
+    tab1, tab3, ftab, h, vol, _, mask, graph, slot0, _ = geo
+    cfg, nu, dtype = sim.cfg, sim.nu, sim.dtype
+    g = sim.grid
+    g_ = _ArgGeom(g.bs, sim._cap, h, g.extent)
+    sol = partial(sim._solver_core, geom=g_, vol=vol, pmask=mask,
+                  graph=graph, slot0=slot0)
+    so = cfg.step_2nd_start == 0
+    h_fine = float(np.min(g.h))
+    uinf = sim.uinf_device()
+
+    def one_step(carry, cfl_eff):
+        vel, p = carry["vel"], carry["p"]
+        umax, time, dtprev = carry["umax"], carry["time"], carry["dt"]
+        cap_dt = (h_fine * h_fine / 6.0) / (nu + (h_fine / 6.0) * umax)
+        dt = jnp.minimum(cfl_eff * h_fine / (umax + 1e-8), cap_dt)
+        dt = jnp.where(dtprev > 0, jnp.minimum(dt, 1.03 * dtprev), dt)
+        vel = amr_ops.rk3_step_blocks(g_, vel, dt, nu, uinf, tab3, ftab)
+        vel, p, stats = amr_ops.project_blocks(
+            g_, vel, dt, sol, tab1, ftab, p_init=p, second_order=so,
+            with_stats=True,
+        )
+        umax_new = jnp.max(jnp.abs(vel + uinf))
+        time_new = time + dt
+        out = {"vel": vel, "p": p, "umax": umax_new, "time": time_new,
+               "dt": dt}
+        row = jnp.concatenate([jnp.asarray(stats, dtype), umax_new[None],
+                               dt[None], time_new[None]])
+        return out, row
+
+    return one_step

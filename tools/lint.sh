@@ -57,6 +57,12 @@ python -m cup3d_tpu.analysis --rules JX013 cup3d_tpu/fleet -q
 echo "== python -m cup3d_tpu.ops.fused_bicgstab"
 JAX_PLATFORMS=cpu python -m cup3d_tpu.ops.fused_bicgstab
 
+# fused forest-kernel smoke (round 15): interpret-vs-twin parity of the
+# bucketed-AMR fused BiCGSTAB on a mixed-level padded forest, padding
+# zero-contribution included — no TPU needed
+echo "== python -m cup3d_tpu.ops.fused_amr_bicgstab"
+JAX_PLATFORMS=cpu python -m cup3d_tpu.ops.fused_amr_bicgstab
+
 # obs trace schema: producer -> validator round trip without a sim
 # (ISSUE 4 satellite; validates real traces with an argument instead;
 # round 13 extends it over the merged host+device Perfetto output)

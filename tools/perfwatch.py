@@ -2,10 +2,12 @@
 """Bench-history trajectory viewer + regression gate (ISSUE 9).
 
 Reads the append-only JSONL store ``bench.py`` writes after every run
-(``cup3d_tpu.obs.history``) and, per tracked metric (``cells_per_s``,
-``bicgstab_iter_device_ms``, ``wall_per_step_p95_s``), compares the
-newest value against the median of the previous N — the BENCH_r0x
-snapshots as a machine-checkable time series.
+(``cup3d_tpu.obs.history``) and, per tracked metric (the
+``DEFAULT_SPECS`` set: ``cells_per_s``, ``bicgstab_iter_device_ms``,
+``wall_per_step_p95_s``, ``fleet_cells_per_s``, ``amr_cells_per_s``,
+``amr_bicgstab_iter_device_ms``), compares the newest value against the
+median of the previous N — the BENCH_r0x snapshots as a
+machine-checkable time series.
 
 Usage::
 
@@ -65,11 +67,19 @@ def selftest() -> None:
     slowdown fires on every tracked metric, and the gate trips."""
     import tempfile
 
-    def mk(cells, iter_ms, p95, fleet):
+    def mk(cells, iter_ms, p95, fleet, amr_scale=1.0):
         return {"value": cells, "unit": "cells/s",
                 "fish": {"wall_per_step_p95_s": p95,
                          "roofline": {"bicgstab_iter_device_ms": iter_ms}},
-                "fleet32": {"fleet_cells_per_s": fleet}}
+                "fleet32": {"fleet_cells_per_s": fleet},
+                # round 15: the adaptive config rides the same store —
+                # its iter-ms lives under roofline.fused when the fused
+                # dispatch gate is on (the tracked spec's first path)
+                "amr_tgv": {
+                    "cells_per_s": 0.5e6 * amr_scale,
+                    "roofline": {"fused": {
+                        "bicgstab_iter_device_ms": 3.0 / amr_scale}},
+                }}
 
     with tempfile.TemporaryDirectory() as td:
         store = obs_history.HistoryStore(os.path.join(td, "hist.jsonl"))
@@ -84,12 +94,14 @@ def selftest() -> None:
         reports = obs_history.detect_regressions(store.summaries())
         assert not obs_history.any_regressed(reports), reports
         # an injected 20% slowdown fires on every tracked metric
-        # (fleet_cells_per_s is direction-aware: a DROP regresses)
-        store.append(mk(0.80e6, 2.40, 0.120, 6.4e6))
+        # (fleet_cells_per_s / amr_cells_per_s are direction-aware:
+        # a DROP regresses; the iter-ms metrics fire on a RISE)
+        store.append(mk(0.80e6, 2.40, 0.120, 6.4e6, amr_scale=0.8))
         reports = obs_history.detect_regressions(store.summaries())
         by = {r["metric"]: r for r in reports}
         for name in ("cells_per_s", "bicgstab_iter_device_ms",
-                     "wall_per_step_p95_s", "fleet_cells_per_s"):
+                     "wall_per_step_p95_s", "fleet_cells_per_s",
+                     "amr_cells_per_s", "amr_bicgstab_iter_device_ms"):
             assert by[name]["regressed"], (name, by[name])
         # a malformed line is skipped, not fatal
         with open(store.path, "a") as f:
