@@ -66,6 +66,14 @@ Rule summary (full rationale in ``analysis/rules.py``):
          B lanes — fleet/batch.py); host-only loops over lanes are
          fine in assembly/fan-out code because they touch no device
          value.
+- JX014  wall-clock subtraction used as a duration: differencing two
+         ``time.time()``/``datetime.now()`` reads inside the package —
+         NTP slews/steps the wall clock, so the "duration" can be
+         negative or jump by seconds and silently corrupts latency
+         histograms and SLO burn rates.  Durations come from the
+         monotonic clock (``obs.trace.now()`` / obs spans); bare
+         ``time.time()`` TIMESTAMPS (history rows, postmortem
+         wall_time) stay legal — only the subtraction fires.
 """
 
 from __future__ import annotations
@@ -155,6 +163,11 @@ JX011_REDUCTIONS = frozenset(
 
 #: keyword args that name an explicit (>= f32) accumulator
 JX011_ACCUM_KWARGS = frozenset({"dtype", "preferred_element_type"})
+
+#: datetime constructors whose reads are wall-clock (JX014); the time
+#: module's own names are resolved per file from its imports, since
+#: ``from time import time`` leaves a bare ``time()`` call behind
+JX014_DATETIME_ATTRS = frozenset({"now", "utcnow", "today"})
 
 
 def _is_host_metadata(expr: ast.AST) -> bool:
@@ -411,6 +424,7 @@ class FileLint:
                 )
             self._check_timing_windows(func, qualname)      # JX006
             self._check_manual_timing(func, qualname)       # JX008
+            self._check_wallclock_duration(func, qualname)  # JX014
             self._check_profiler_usage(func, qualname)      # JX012
             self._check_swallowed_exceptions(func, qualname)  # JX009
             if JX010_MODULE_RE.search(self.path) and bool(
@@ -423,6 +437,7 @@ class FileLint:
                 self._check_lane_device_loop(func, qualname)  # JX013
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
+        self._check_wallclock_duration(self.tree, "<module>")  # JX014
         self._check_profiler_usage(self.tree, "<module>")   # JX012
         if JX011_MODULE_RE.search(self.path):
             self._check_bf16_reduction(self.tree, "<module>")  # JX011
@@ -859,6 +874,115 @@ class FileLint:
                 "spans (obs.trace.SpanTimer / the driver profiler) or "
                 "obs metrics so the measurement reaches the registry "
                 "and the step trace",
+            )
+
+    # -- JX014 -------------------------------------------------------------
+
+    def _wallclock_call_names(self) -> Set[str]:
+        """Dotted call names that read the WALL clock in this file,
+        resolved from its imports: ``time.time`` under whatever alias
+        the time module was imported as, the bare name ``from time
+        import time [as X]`` leaves behind, and the datetime
+        now/utcnow/today constructors."""
+        cached = getattr(self, "_jx014_names", None)
+        if cached is not None:
+            return cached
+        names: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name
+                    if a.name == "time":
+                        names.add(f"{alias}.time")
+                    elif a.name == "datetime":
+                        for attr in JX014_DATETIME_ATTRS:
+                            names.add(f"{alias}.datetime.{attr}")
+                            names.add(f"{alias}.date.{attr}")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time":
+                    for a in node.names:
+                        if a.name == "time":
+                            names.add(a.asname or a.name)
+                elif node.module == "datetime":
+                    for a in node.names:
+                        if a.name in ("datetime", "date"):
+                            alias = a.asname or a.name
+                            for attr in JX014_DATETIME_ATTRS:
+                                names.add(f"{alias}.{attr}")
+        self._jx014_names = names
+        return names
+
+    def _check_wallclock_duration(self, func: ast.AST,
+                                  qualname: str) -> None:
+        """Subtraction whose operands trace back to wall-clock reads:
+        a duration computed from ``time.time()``/``datetime.now()``
+        (directly, or through names/attributes assigned from them in
+        this function).  Timestamp-only uses never subtract and stay
+        silent; subtracting a numeric CONSTANT from a wall-clock read
+        is timestamp arithmetic ("an hour ago") and stays silent too."""
+        if not self.path.startswith("cup3d_tpu/"):
+            return
+        wall = self._wallclock_call_names()
+        if not wall:
+            return
+
+        def is_wall_call(node: ast.AST) -> bool:
+            return (isinstance(node, ast.Call)
+                    and _call_name(node) in wall)
+
+        # names/attributes assigned from a wall-clock read, iterated to
+        # a fixpoint so t1 = time.time(); t2 = t1 taints t2 as well
+        tainted: Set[str] = set()
+        stmts = [n for n in _walk_shallow(func)
+                 if isinstance(n, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign))]
+        for _ in range(3):
+            grew = False
+            for stmt in stmts:
+                value = stmt.value
+                if value is None:
+                    continue
+                hit = any(is_wall_call(n) for n in ast.walk(value)) or any(
+                    isinstance(n, (ast.Name, ast.Attribute))
+                    and _dotted(n) in tainted
+                    for n in ast.walk(value)
+                )
+                if not hit:
+                    continue
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        name = _dotted(leaf)
+                        if name and name not in tainted:
+                            tainted.add(name)
+                            grew = True
+            if not grew:
+                break
+
+        def is_wallish(node: ast.AST) -> bool:
+            if is_wall_call(node):
+                return True
+            return (isinstance(node, (ast.Name, ast.Attribute))
+                    and _dotted(node) in tainted)
+
+        for node in _walk_shallow(func):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            l_wall, r_wall = is_wallish(node.left), is_wallish(node.right)
+            if not (l_wall or r_wall):
+                continue
+            other = node.right if l_wall else node.left
+            if isinstance(other, ast.Constant):
+                continue  # timestamp arithmetic, not a duration
+            self._emit(
+                "JX014", node, qualname,
+                "wall-clock subtraction used as a duration: "
+                "time.time()/datetime.now() differences are NTP-"
+                "slewed and can go negative — use the monotonic "
+                "clock (obs.trace.now() at lifecycle seams, or obs "
+                "spans/metrics) for durations",
             )
 
     # -- JX012 -------------------------------------------------------------

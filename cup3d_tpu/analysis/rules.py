@@ -171,6 +171,22 @@ RULES: Dict[str, Rule] = {
             "no device value.",
         ),
         Rule(
+            "JX014",
+            "wall-clock subtraction used as a duration",
+            "Subtracting two time.time() (or datetime.now()) reads "
+            "measures the WALL clock, which NTP slews and steps: a "
+            "duration computed this way can come out negative, jump by "
+            "whole seconds, and silently corrupts latency histograms "
+            "and SLO burn rates (the round-16 job observatory gates on "
+            "p99 completion latency, so a stepped clock is a paged "
+            "on-call).  Durations must come from the monotonic clock — "
+            "obs.trace.now() (perf_counter on the trace epoch) at "
+            "lifecycle seams, or the obs span/metric primitives.  "
+            "time.time() stays legitimate for TIMESTAMPS (history "
+            "store rows, postmortem wall_time, /health time): the rule "
+            "fires only on wall-clock SUBTRACTION.",
+        ),
+        Rule(
             "JX012",
             "direct jax.profiler use outside the obs layer",
             "jax.profiler.start_trace/stop_trace/TraceAnnotation called "

@@ -139,6 +139,19 @@ def _gated(core, has_gait):
     return body
 
 
+#: lane-track tid stride: lane tids are ``batch_id * LANE_TID_STRIDE +
+#: lane`` so concurrent batches never share a Perfetto thread track
+#: (the pid-3 job-occupancy export, obs/trace.LANE_PID)
+LANE_TID_STRIDE = 4096
+
+
+def lane_track_id(batch_id: int, lane: int) -> int:
+    """Stable Perfetto tid of one (batch, lane) occupancy track —
+    shared by fleet/server.py (emission) and tools/trace_check.py
+    (validation: spans on one tid must not overlap)."""
+    return int(batch_id) * LANE_TID_STRIDE + int(lane)
+
+
 def fleet_mesh() -> Optional["jax.sharding.Mesh"]:
     """The optional lanes mesh: a 1-D device mesh named ``lanes`` when
     CUP3D_FLEET_MESH is on and more than one device is visible, else

@@ -6,6 +6,12 @@ The spec file is either a JSON list of scenario dicts or an object
 fleet/server.py job spec (kind, nsteps, n, cfl, L/T/xpos, ...) plus an
 optional ``tenant`` name.  The process drains the whole queue and
 prints the per-tenant summary JSON on stdout.
+
+``python -m cup3d_tpu fleet slo --scenarios spec.json`` drains the same
+way but prints the SLO report instead: per-tenant p50/p95/p99 job
+latency (from the obs/metrics.py bucketed histograms), breach counts
+against the target p99, and the burn rate over the 1% error budget.
+``--slo-p99``/``--slo-window`` override the CUP3D_FLEET_SLO_* knobs.
 """
 
 from __future__ import annotations
@@ -17,11 +23,11 @@ from typing import List, Optional
 from cup3d_tpu.fleet.server import FleetServer, summary_json
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="python -m cup3d_tpu fleet",
-        description="drain a fleet scenario spec and print the "
-                    "per-tenant summary JSON")
+def _build_parser(slo: bool) -> argparse.ArgumentParser:
+    prog = "python -m cup3d_tpu fleet" + (" slo" if slo else "")
+    desc = ("drain a fleet scenario spec and print the per-tenant "
+            + ("SLO report JSON" if slo else "summary JSON"))
+    ap = argparse.ArgumentParser(prog=prog, description=desc)
     ap.add_argument("--scenarios", required=True,
                     help="JSON spec: a list of scenarios or "
                          '{"scenarios": [...], "lanes": N, "buckets": N}')
@@ -31,7 +37,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="executable cache cap (CUP3D_FLEET_BUCKETS)")
     ap.add_argument("--workdir", default=None,
                     help="serialization dir (default: fresh tempdir)")
-    args = ap.parse_args(argv)
+    if slo:
+        ap.add_argument("--slo-p99", type=float, default=None,
+                        help="target p99 end-to-end seconds "
+                             "(CUP3D_FLEET_SLO_P99)")
+        ap.add_argument("--slo-window", type=int, default=None,
+                        help="rolling breach window in jobs "
+                             "(CUP3D_FLEET_SLO_WINDOW)")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    slo = bool(argv) and argv[0] == "slo"
+    if slo:
+        argv = argv[1:]
+    args = _build_parser(slo).parse_args(argv)
 
     with open(args.scenarios) as f:
         spec = json.load(f)
@@ -46,11 +69,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         raise SystemExit("no scenarios in spec")
 
     server = FleetServer(max_lanes=lanes, max_buckets=buckets,
-                         workdir=args.workdir)
+                         workdir=args.workdir,
+                         slo_p99_s=getattr(args, "slo_p99", None),
+                         slo_window=getattr(args, "slo_window", None))
     for i, sc in enumerate(scenarios):
         server.submit(sc.get("tenant", f"tenant-{i}"), sc)
     summary = server.drain()
-    print(summary_json(summary))
+    if slo:
+        report = {"slo": server.slo_status(),
+                  "quantiles": server.latency_quantiles(),
+                  "jobs": server.jobs_by_status()}
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(summary_json(summary))
     bad = sum(
         st.get("failed", 0) for st in
         (t["statuses"] for t in summary.values()))

@@ -21,24 +21,40 @@ The serving pipeline, one layer per concern:
    tenant's rows in (step) order into that job's QoI buffer — a
    deterministic, byte-stable ordering per tenant.
 
+Round 16 — the job-lifecycle observatory: every job carries a
+monotonic-clock span timeline (``obs.trace.now()`` marks at the
+lifecycle seams: submitted -> queued -> bucketed -> running ->
+dispatched -> fanout -> rollback*/retire -> done/failed/cancelled).
+Timestamps are host clock reads at seam transitions ONLY — the dispatch
+loop itself never takes one per step, and nothing here reads a device
+value.  At a job's terminal transition the server (1) observes
+queue-wait / execution / end-to-end durations into per-tenant,
+per-bucket ``fleet.job_*_s`` histograms (obs/metrics.py log buckets ->
+p50/p95/p99), (2) tracks the per-tenant SLO window (target p99 +
+rolling breach window -> burn-rate counters in ``health()``), and
+(3) when tracing is on, emits one ``kind="job"`` aux record plus a
+pid-3 lane-occupancy span into the Perfetto export (obs/trace.py).
+
 Env knobs: ``CUP3D_FLEET_LANES`` caps lanes per batch (default 64),
 ``CUP3D_FLEET_BUCKETS`` caps the executable cache (default 8, LRU),
-``CUP3D_FLEET_MESH=1`` shards the lane axis over visible devices, and
-``CUP3D_SNAP_EVERY``/``CUP3D_MAX_RETRIES`` carry their resilience/
-meanings per lane.  Live servers surface in the obs /health payload
-(obs/export.py) through the same weakref registry pattern as the
-flight recorders.
+``CUP3D_FLEET_MESH=1`` shards the lane axis over visible devices,
+``CUP3D_FLEET_SLO_P99``/``CUP3D_FLEET_SLO_WINDOW`` set the completion
+SLO (target p99 seconds, rolling job window), and ``CUP3D_SNAP_EVERY``/
+``CUP3D_MAX_RETRIES`` carry their resilience meanings per lane.  Live
+servers surface in the obs /health payload (obs/export.py) through the
+same weakref registry pattern as the flight recorders.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
 import weakref
-from collections import OrderedDict
-from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -46,7 +62,9 @@ from cup3d_tpu.config import SimulationConfig
 from cup3d_tpu.fleet import batch as FB
 from cup3d_tpu.fleet import isolate as ISO
 from cup3d_tpu.grid.bucket import count_capacity
+from cup3d_tpu.obs import flight as _flight
 from cup3d_tpu.obs import metrics as M
+from cup3d_tpu.obs import trace as OT
 from cup3d_tpu.sim.dtpolicy import ramped_cfl
 from cup3d_tpu.sim.megaloop import (
     DEFAULT_SCAN_K,
@@ -76,6 +94,15 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    # jax-lint: allow(JX009, malformed env knob falls back to the
+    # default; the effective value is visible in health()["slo"])
+    except ValueError:
+        return default
+
+
 @dataclass
 class FleetJob:
     """One tenant scenario: spec in, per-step QoI rows + final lane
@@ -93,6 +120,53 @@ class FleetJob:
     lane: int = -1
     batch: Optional["FleetBatch"] = None
     cfg: Optional[SimulationConfig] = None
+    #: bucket-signature label for the SLO histograms (set at assembly)
+    sig_label: str = ""
+    #: the monotonic span timeline: (event, obs.trace.now()) appends at
+    #: lifecycle seams — never inside the per-step hot loop
+    events: List[Tuple[str, float]] = field(default_factory=list)
+    _seen: Set[str] = field(default_factory=set, repr=False)
+
+    def mark(self, event: str, once: bool = False) -> None:
+        """Append one lifecycle event at the current monotonic time.
+        ``once`` drops repeats (dispatched/fanout fire per dispatch
+        otherwise).  The timestamp is clamped non-decreasing: marks may
+        arrive from the dispatch thread and the QoI consumer thread, and
+        the timeline is validated monotone (obs/trace.py)."""
+        if once and event in self._seen:
+            return
+        self._seen.add(event)
+        t = OT.now()
+        if self.events and t < self.events[-1][1]:
+            t = self.events[-1][1]
+        self.events.append((event, t))
+
+    def event_time(self, event: str) -> Optional[float]:
+        """First occurrence time of ``event`` (None when absent)."""
+        for n, t in self.events:
+            if n == event:
+                return t
+        return None
+
+    def durations(self) -> Dict[str, float]:
+        """The SLO-relevant durations derivable from the timeline:
+        queue-wait (queued -> running), execution (running -> terminal)
+        and end-to-end (submitted -> terminal) — all on the monotonic
+        clock, present only when both endpoints were marked."""
+        out: Dict[str, float] = {}
+        if not self.events:
+            return out
+        t_end = self.events[-1][1]
+        t_q = self.event_time("queued")
+        t_run = self.event_time("running")
+        t_sub = self.event_time("submitted")
+        if t_q is not None and t_run is not None:
+            out["queue_wait_s"] = t_run - t_q
+        if t_run is not None:
+            out["exec_s"] = t_end - t_run
+        if t_sub is not None:
+            out["e2e_s"] = t_end - t_sub
+        return out
 
     def record(self, step: int, row: np.ndarray, t: float) -> None:
         """Append (or re-apply, after a lane rollback replay) the QoI
@@ -300,7 +374,12 @@ class FleetBatch:
             job.lane = lane
             job.batch = self
             job.status = RUNNING
+            job.mark("running")
             job.rows = np.zeros((job.nsteps, self.row_w), np.float64)
+        #: lanes whose job has not had its first dispatch marked yet —
+        #: steady-state dispatch() pays one empty-set truth test
+        self._undispatched: Set[int] = {
+            lane for lane, j in enumerate(self.jobs) if j is not None}
 
         self.carry = FB.stack_carries(carries, targets)
         self.gaits = FB.stack_gaits(gaits, s.dtype) if gaits else None
@@ -349,6 +428,13 @@ class FleetBatch:
         """One batched advance: every live lane moves K steps, one QoI
         block goes onto the stream."""
         valid = np.minimum(self.left_h, self.K).astype(np.int64)
+        if self._undispatched:
+            for lane in sorted(self._undispatched):
+                if valid[lane] > 0:
+                    job = self.jobs[lane]
+                    if job is not None:
+                        job.mark("dispatched", once=True)
+                    self._undispatched.discard(lane)
         carry, rows = self.advance(self.carry, self._cfl_block(), self.gaits)
         self.carry = carry
         entry = self.stream.pack_parts(
@@ -400,6 +486,8 @@ class FleetBatch:
                 continue
             if epochs[lane] != self.guard.epochs[lane]:
                 continue  # stale rows from an abandoned lane trajectory
+            if valid[lane] > 0:
+                job.mark("fanout", once=True)
             for k in range(int(valid[lane])):
                 step = int(step0[lane]) + k
                 row = rows[lane, k]
@@ -426,6 +514,9 @@ class FleetBatch:
             job.error = reason
             self.retire(lane, FAILED, "failed")
             return
+        job = self.jobs[lane]
+        if job is not None:
+            job.mark("rollback")
         self.carry, snap_step, snap_left = self.guard.rollback(
             self.carry, lane, step, reason)
         self.step_h[lane] = snap_step
@@ -436,8 +527,11 @@ class FleetBatch:
         if job is None or job.status not in (RUNNING,):
             return
         job.status = status
+        job.mark("retire")
+        job.mark(status)
         M.counter("fleet.lane_retires", reason=reason).inc()
         self.server.update_lane_gauge()
+        self.server._job_terminal(job, batch=self, lane=lane)
 
     def cancel_lane(self, lane: int) -> None:
         """Freeze the lane (bits of every other lane untouched) and
@@ -475,11 +569,16 @@ def live_servers() -> List["FleetServer"]:
 class FleetServer:
     """The multi-tenant front door: queue, assembly, dispatch, fan-out."""
 
+    #: SLO error budget matching a p99 target: 1% of jobs may breach
+    SLO_ERROR_BUDGET = 0.01
+
     def __init__(self, max_lanes: Optional[int] = None,
                  max_buckets: Optional[int] = None,
                  snap_every: Optional[int] = None,
                  max_retries: Optional[int] = None,
-                 workdir: Optional[str] = None):
+                 workdir: Optional[str] = None,
+                 slo_p99_s: Optional[float] = None,
+                 slo_window: Optional[int] = None):
         self.max_lanes = int(
             max_lanes if max_lanes is not None
             else _env_int("CUP3D_FLEET_LANES", 64))
@@ -498,6 +597,15 @@ class FleetServer:
         self._next_job = 0
         self._next_batch = 0
         self.mesh = FB.fleet_mesh()
+        # completion SLO: target p99 end-to-end seconds + rolling
+        # per-tenant breach window (health()["slo"], fleet slo CLI)
+        self.slo_p99_s = float(
+            slo_p99_s if slo_p99_s is not None
+            else _env_float("CUP3D_FLEET_SLO_P99", 60.0))
+        self.slo_window = max(1, int(
+            slo_window if slo_window is not None
+            else _env_int("CUP3D_FLEET_SLO_WINDOW", 100)))
+        self._slo_windows: Dict[str, deque] = {}
         _LIVE.append(weakref.ref(self))
 
     # -- tenant lifecycle --------------------------------------------------
@@ -513,6 +621,8 @@ class FleetServer:
         self._next_job += 1
         job = FleetJob(job_id=job_id, tenant=str(tenant), spec=dict(spec),
                        nsteps=int(spec["nsteps"]))
+        job.mark("submitted")
+        job.mark("queued")
         self._jobs[job_id] = job
         M.counter("fleet.submits").inc()
         return job_id
@@ -526,7 +636,9 @@ class FleetServer:
         job = self._jobs[job_id]
         if job.status == QUEUED:
             job.status = CANCELLED
+            job.mark("cancelled")
             M.counter("fleet.lane_retires", reason="cancelled").inc()
+            self._job_terminal(job)
             return True
         if job.status == RUNNING and job.batch is not None:
             job.batch.cancel_lane(job.lane)
@@ -586,10 +698,19 @@ class FleetServer:
             if not drv._megaloop_eligible():
                 job.status = FAILED
                 job.error = "scenario not scan-eligible"
+                job.mark("failed")
                 M.counter("fleet.lane_retires", reason="ineligible").inc()
+                self._job_terminal(job)
                 continue
-            key = (_static_signature(drv, kind),
-                   count_capacity(job.nsteps, base=1))
+            sig = _static_signature(drv, kind)
+            key = (sig, count_capacity(job.nsteps, base=1))
+            # deterministic bucket-signature label for the SLO
+            # histograms (hash(), being per-process salted, would split
+            # one bucket's series across restarts)
+            job.sig_label = "{}-{}".format(
+                kind,
+                hashlib.blake2s(repr(key).encode()).hexdigest()[:8])
+            job.mark("bucketed")
             buckets.setdefault(key, []).append((kind, job, drv))
         for (sig, _rung), members in buckets.items():
             for i in range(0, len(members), self.max_lanes):
@@ -628,6 +749,100 @@ class FleetServer:
 
     # -- observability -----------------------------------------------------
 
+    def _job_terminal(self, job: FleetJob, batch: Optional[FleetBatch]
+                      = None, lane: Optional[int] = None) -> None:
+        """One job reached done/failed/cancelled: fold its timeline into
+        the SLO histograms + breach window, notify the flight recorders,
+        and (tracing on) emit the kind="job" aux record and the pid-3
+        lane-occupancy span.  Called exactly once per job — every
+        terminal transition funnels through here."""
+        durs = job.durations()
+        bucket = job.sig_label or "unbucketed"
+        if "queue_wait_s" in durs:
+            M.histogram("fleet.job_queue_wait_s", tenant=job.tenant,
+                        bucket=bucket).observe(durs["queue_wait_s"])
+        if "exec_s" in durs:
+            M.histogram("fleet.job_exec_s", tenant=job.tenant,
+                        bucket=bucket).observe(durs["exec_s"])
+        e2e = durs.get("e2e_s")
+        if e2e is not None:
+            M.histogram("fleet.job_e2e_s", tenant=job.tenant,
+                        bucket=bucket).observe(e2e)
+            wnd = self._slo_windows.setdefault(
+                job.tenant, deque(maxlen=self.slo_window))
+            breached = e2e > self.slo_p99_s
+            wnd.append(bool(breached))
+            if breached:
+                M.counter("fleet.slo_breaches", tenant=job.tenant).inc()
+        for fr in _flight.live_recorders():
+            fr.note_job({"job_id": job.job_id, "tenant": job.tenant,
+                         "status": job.status,
+                         "steps_done": int(job.steps_done),
+                         **{k: round(v, 6) for k, v in durs.items()}})
+        sink = OT.TRACE
+        if not sink.enabled:
+            return
+        rec = OT.job_record(
+            job.job_id, job.tenant, job.status, job.steps_done,
+            job.events, bucket=bucket,
+            durations={k: round(v, 6) for k, v in durs.items()})
+        if batch is not None and lane is not None:
+            rec["batch"] = int(batch.batch_id)
+            rec["lane"] = int(lane)
+        sink.aux(rec)
+        t_run = job.event_time("running")
+        if batch is not None and lane is not None and t_run is not None:
+            tid = FB.lane_track_id(batch.batch_id, lane)
+            t_end = job.events[-1][1]
+            sink.lane_span(
+                tid, job.job_id, t_run, t_end - t_run,
+                args={"job_id": job.job_id, "tenant": job.tenant,
+                      "status": job.status, "bucket": bucket,
+                      "steps_done": int(job.steps_done)})
+            for name, t in job.events:
+                if name == "rollback":
+                    sink.lane_instant(tid, "rollback", t,
+                                      args={"job_id": job.job_id})
+
+    def latency_quantiles(self, name: str = "fleet.job_e2e_s",
+                          tenant: Optional[str] = None,
+                          qs: Tuple[float, ...] = (0.5, 0.95, 0.99)
+                          ) -> Dict[str, Optional[float]]:
+        """Aggregate quantiles over one job-latency histogram family
+        (optionally one tenant's slice), merging bucket counts across
+        label sets — the PromQL ``histogram_quantile(sum by (le))``
+        computed in-process.  Values are None until a first job lands.
+        Note the registry is process-global: the family aggregates over
+        every server in the process, exactly like a scrape would."""
+        hists = [h for h in M.histograms(name)
+                 if tenant is None or h.labels.get("tenant") == tenant]
+        return {f"p{int(round(q * 100))}": M.merged_quantile(hists, q)
+                for q in qs}
+
+    def slo_status(self) -> dict:
+        """The per-tenant SLO view (health()["slo"], fleet slo CLI):
+        target, rolling-window breach fraction, and the burn rate —
+        breach fraction over the 1% error budget a p99 target implies
+        (burn 1.0 = exactly on budget, >1 = burning ahead of it)."""
+        tenants = {}
+        for tenant, wnd in sorted(self._slo_windows.items()):
+            n = len(wnd)
+            b = int(sum(wnd))
+            frac = (b / n) if n else 0.0
+            tenants[tenant] = {
+                "jobs": n,
+                "breaches": b,
+                "breach_fraction": round(frac, 4),
+                "burn_rate": round(frac / self.SLO_ERROR_BUDGET, 2),
+                "quantiles": self.latency_quantiles(tenant=tenant),
+            }
+        return {
+            "target_p99_s": self.slo_p99_s,
+            "window": self.slo_window,
+            "error_budget": self.SLO_ERROR_BUDGET,
+            "tenants": tenants,
+        }
+
     def update_lane_gauge(self) -> None:
         M.gauge("fleet.lanes_active").set(
             float(sum(b.running_lanes() for b in self.batches)))
@@ -665,6 +880,7 @@ class FleetServer:
             "dispatches": int(sum(b.dispatches for b in self.batches)),
             "rollbacks": int(sum(b.guard.rollbacks for b in self.batches)),
             "executables": len(self._execs),
+            "slo": self.slo_status(),
             "knobs": {
                 "max_lanes": self.max_lanes,
                 "max_buckets": self.max_buckets,

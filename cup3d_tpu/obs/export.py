@@ -11,9 +11,17 @@ server thread, and the registry's own lock is the only shared state.
 ``/metrics`` renders the flat ``obs/metrics.py`` snapshot keys
 (``name{k=v,...}[.suffix]``) into Prometheus exposition format 0.0.4:
 ``cup3d_`` prefix, dots -> underscores, labels quoted/escaped, one
-``# TYPE`` line per family (untyped: the flat snapshot does not carry
-metric kinds).  :func:`parse_prometheus_text` is the matching parser —
-the round-trip is a tested contract, not a formatting accident.
+``# TYPE`` line per family.  Round 16: registered histograms render as
+REAL histogram families — ``# TYPE ... histogram`` with cumulative
+``_bucket{le="..."}`` lines (the pinned ``obs.metrics.BUCKET_BOUNDS``
+ladder + ``+Inf``), ``_sum`` and ``_count`` — so ``histogram_quantile``
+works on a scrape; everything else stays untyped.  The legacy flat
+``.count``/``.sum`` suffix keys remain in ``snapshot()`` for existing
+consumers but are excluded from the text rendering for histogram
+families (they would collide with the conformant ``_count``/``_sum``).
+:func:`parse_prometheus_text` is the matching parser and
+:func:`parse_histograms` regroups the bucket series — the round-trip
+is a tested contract, not a formatting accident.
 
 ``/health`` reports what a supervisor needs before scraping history:
 per-flight-recorder arm state + last-known-good step (the weakref
@@ -81,25 +89,68 @@ def _fmt_value(v: float) -> str:
     return repr(v)
 
 
-def render_prometheus(snap: Optional[Dict[str, float]] = None) -> str:
-    """The registry snapshot as Prometheus exposition text 0.0.4."""
-    snap = _metrics.snapshot() if snap is None else snap
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_le(le: float) -> str:
+    """A bucket bound as an ``le`` label value (``+Inf`` for overflow;
+    ``repr`` otherwise so ``float()`` round-trips exactly)."""
+    return "+Inf" if math.isinf(le) else repr(le)
+
+
+def render_prometheus(snap: Optional[Dict[str, float]] = None,
+                      histograms=None) -> str:
+    """The registry snapshot as Prometheus exposition text 0.0.4.
+
+    With no arguments (the live scrape path) registered histograms are
+    rendered as conformant histogram families (``_bucket``/``_sum``/
+    ``_count``) and their legacy flat ``.count``/``.sum`` keys dropped
+    from the untyped section.  An explicit ``snap`` without
+    ``histograms`` renders the old untyped-only text (back-compat for
+    callers formatting an arbitrary flat dict)."""
+    if snap is None:
+        snap = _metrics.snapshot()
+        if histograms is None:
+            histograms = _metrics.histograms()
+    histograms = list(histograms or ())
+    lines = []
+    skip = set()
+    # histogram families first: group per prometheus base name so each
+    # family gets exactly one TYPE line across all label sets
+    hist_fams: Dict[str, list] = {}
+    for h in histograms:
+        name, labels = prometheus_key(h.flat)
+        hist_fams.setdefault(name, []).append((labels, h))
+        # the conformant _count/_sum replace the legacy suffix gauges
+        # (identical sanitized names would otherwise collide); min/max/
+        # last keep rendering untyped below — no conformant equivalent
+        skip.add(f"{h.flat}.count")
+        skip.add(f"{h.flat}.sum")
+    for name in sorted(hist_fams):
+        lines.append(f"# TYPE {name} histogram")
+        for labels, h in hist_fams[name]:
+            for le, cum in h.cumulative_buckets():
+                blabels = dict(labels)
+                blabels["le"] = _fmt_le(le)
+                lines.append(f"{name}_bucket{_label_str(blabels)} {cum}")
+            lstr = _label_str(labels)
+            lines.append(f"{name}_sum{lstr} {_fmt_value(h.sum)}")
+            lines.append(f"{name}_count{lstr} {h.count}")
     families: Dict[str, list] = {}
     for flat in sorted(snap):
+        if flat in skip:
+            continue
         name, labels = prometheus_key(flat)
         families.setdefault(name, []).append((labels, snap[flat]))
-    lines = []
     for name, series in families.items():
         lines.append(f"# TYPE {name} untyped")
         for labels, val in series:
-            lstr = ""
-            if labels:
-                inner = ",".join(
-                    f'{k}="{_escape_label(str(v))}"'
-                    for k, v in sorted(labels.items())
-                )
-                lstr = "{" + inner + "}"
-            lines.append(f"{name}{lstr} {_fmt_value(val)}")
+            lines.append(f"{name}{_label_str(labels)} {_fmt_value(val)}")
     return "\n".join(lines) + "\n"
 
 
@@ -124,6 +175,46 @@ def parse_prometheus_text(text: str) -> Dict[Tuple[str, frozenset], float]:
     return out
 
 
+def parse_histograms(text: str) -> Dict[Tuple[str, frozenset], dict]:
+    """Regroup an exposition's histogram series: ``{(family_name,
+    frozenset(labels-without-le)): {"buckets": [(le, cum), ...
+    ascending, +Inf last], "sum": float, "count": float}}``.
+
+    The inverse of the histogram half of :func:`render_prometheus`
+    (family name still carries the ``cup3d_`` prefix).  Families appear
+    only once a ``_bucket`` line is seen; buckets are checked monotone
+    non-decreasing in cumulative count (ValueError otherwise — a
+    non-cumulative rendering is a bug, not a dialect)."""
+    samples = parse_prometheus_text(text)
+    fams: Dict[Tuple[str, frozenset], dict] = {}
+
+    def fam(name: str, labels: frozenset) -> dict:
+        return fams.setdefault((name, labels),
+                               {"buckets": [], "sum": None, "count": None})
+
+    for (name, labels), val in samples.items():
+        if name.endswith("_bucket"):
+            ldict = dict(labels)
+            le = ldict.pop("le", None)
+            if le is None:
+                continue  # a _bucket-suffixed untyped metric, not ours
+            fam(name[:-len("_bucket")],
+                frozenset(ldict.items()))["buckets"].append(
+                    (float(le), val))
+    for (name, labels), val in samples.items():
+        for suffix, field in (("_sum", "sum"), ("_count", "count")):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and (base, labels) in fams:
+                fams[(base, labels)][field] = val
+    for (name, labels), rec in fams.items():
+        rec["buckets"].sort(key=lambda b: b[0])
+        cums = [c for _, c in rec["buckets"]]
+        if cums != sorted(cums):
+            raise ValueError(
+                f"histogram {name}{dict(labels)}: non-cumulative buckets")
+    return fams
+
+
 # -- /health ----------------------------------------------------------------
 
 
@@ -142,6 +233,7 @@ def health_payload() -> dict:
             "steps_recorded": len(fr.steps),
             "dumps_written": list(fr.dumps_written),
             "recovery_events": len(fr.recovery_events),
+            "job_events": len(fr.job_events),
         })
     counters = {k: v for k, v in snap.items()
                 if k.startswith(("flight.", "resilience.", "recovery.",

@@ -129,6 +129,10 @@ class FlightRecorder:
         # of rollback/retry events rides in any LATER postmortem.
         self.recovery_intercept: Optional[Callable[[str, dict], bool]] = None
         self.recovery_events: deque = deque(maxlen=64)
+        # round-16 serving observatory: terminal fleet-job events
+        # (fleet/server.py notifies every live recorder) ride along in
+        # postmortems — a lane dying mid-drain keeps its serving context
+        self.job_events: deque = deque(maxlen=64)
         _LIVE.add(self)
 
     @property
@@ -141,6 +145,12 @@ class FlightRecorder:
         O(1) host work — part of every postmortem payload)."""
         self.recovery_events.append(dict(event))
         _metrics.counter("flight.recovery_events").inc()
+
+    def note_job(self, event: dict) -> None:
+        """Append one terminal fleet-job event (job_id/tenant/status/
+        durations; O(1) host work — part of every postmortem payload)."""
+        self.job_events.append(dict(event))
+        _metrics.counter("flight.job_events").inc()
 
     # -- recording (hot path: O(1) host appends) ---------------------------
 
@@ -210,6 +220,7 @@ class FlightRecorder:
             "steps": [_jsonable(r) for r in self.steps],
             "residual_history": list(self.residuals),
             "recovery_events": [_jsonable(e) for e in self.recovery_events],
+            "job_events": [_jsonable(e) for e in self.job_events],
             "metrics": _jsonable(_metrics.snapshot()),
         }
         os.makedirs(self.directory or ".", exist_ok=True)

@@ -81,6 +81,15 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("amr_tgv", "roofline", "bicgstab_iter_device_ms")),
         higher_is_better=False,
     ),
+    # round 16 (serving observatory): p99 end-to-end job completion
+    # latency of the seeded fleet_slo arrival trace (bench.py), from the
+    # obs/metrics.py bucketed histograms — tail latency, lower is better
+    MetricSpec(
+        "fleet_job_p99_s",
+        (("fleet_slo", "fleet_job_p99_s"),
+         ("detail", "fleet_job_p99_s")),
+        higher_is_better=False,
+    ),
 )
 
 

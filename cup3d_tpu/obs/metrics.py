@@ -15,7 +15,12 @@ those a named home:
 - :class:`Gauge` — last-written value (bucket capacity, last solver
   iteration count).
 - :class:`Histogram` — count/sum/min/max/last of observations (solver
-  iterations, stall seconds) without storing samples.
+  iterations, stall seconds) without storing samples, plus fixed
+  log-spaced bucket counts (round 16): still O(1) state per observe,
+  but quantiles (p50/p95/p99 job completion latency — ROADMAP item 2)
+  become estimable to within one bucket width, and ``obs/export.py``
+  renders the buckets as conformant Prometheus ``_bucket{le=...}``
+  exposition.
 
 Metrics are keyed by ``(name, labels)``; ``counter("stream.bytes",
 stream="qoi")`` returns the same object on every call, so hot paths
@@ -46,9 +51,86 @@ from __future__ import annotations
 
 import threading
 import weakref
-from typing import Callable, Dict, List, Optional, Tuple
+from bisect import bisect_left
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+#: The pinned histogram bucket ladder (VALIDATION.md Round 16 contract):
+#: log-spaced upper bounds covering 1e-5 .. 1e3 (10 µs .. ~17 min when
+#: observing seconds; fractions of an iteration .. 1000 when observing
+#: solver iteration counts) at 8 buckets per decade — a ~33% geometric
+#: step, so a quantile estimate is off by at most one bucket width
+#: (≈15% relative after log-interpolation).  66 integer counters per
+#: histogram (64 finite + the le=1e-5 floor bucket + overflow): cheap
+#: enough to keep the observe() hot path a bisect + two adds.
+BUCKETS_PER_DECADE = 8
+_DECADES = (-5, 3)  # 10**-5 .. 10**3 inclusive
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    10.0 ** (_DECADES[0] + i / BUCKETS_PER_DECADE)
+    for i in range((_DECADES[1] - _DECADES[0]) * BUCKETS_PER_DECADE + 1)
+)
+
+
+def bucket_index(v: float) -> int:
+    """Index into the per-histogram count array for one observation:
+    ``i < len(BUCKET_BOUNDS)`` means ``v <= BUCKET_BOUNDS[i]`` (and
+    ``v > BUCKET_BOUNDS[i-1]``); ``i == len(BUCKET_BOUNDS)`` is the
+    overflow (+Inf) bucket."""
+    return bisect_left(BUCKET_BOUNDS, v)
+
+
+def quantile_from_buckets(counts: Sequence[int], total: int,
+                          mn: Optional[float], mx: Optional[float],
+                          q: float) -> Optional[float]:
+    """Quantile estimate from one (possibly merged) bucket-count array.
+
+    Log-linear interpolation inside the containing bucket; the floor
+    bucket answers ``mn`` and the overflow bucket ``mx`` (the exact
+    extremes are tracked, so the tails never extrapolate past reality).
+    The result is clamped to [mn, mx] — the one-bucket-width error
+    bound the Round 16 contract pins.  None when empty."""
+    if total <= 0 or mn is None or mx is None:
+        return None
+    q = min(1.0, max(0.0, float(q)))
+    target = max(1, int(q * total + 0.9999999999))  # ceil without math
+    cum = 0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        cum += c
+        if cum < target:
+            continue
+        if i >= len(BUCKET_BOUNDS):          # overflow: > top bound
+            return mx
+        if i == 0:                           # floor bucket: <= 1e-5
+            return mn
+        lo, hi = BUCKET_BOUNDS[i - 1], BUCKET_BOUNDS[i]
+        frac = (target - (cum - c)) / c
+        est = lo * (hi / lo) ** frac
+        return min(max(est, mn), mx)
+    return mx  # counts/total disagree (merged snapshots): best effort
+
+
+def merged_quantile(hists: Iterable["Histogram"], q: float
+                    ) -> Optional[float]:
+    """One quantile across several histograms (e.g. the per-tenant
+    ``fleet.job_e2e_s`` family) by summing their bucket counts —
+    exactly what a PromQL ``histogram_quantile(sum by (le))`` would
+    compute from the exported ``_bucket`` series."""
+    counts = [0] * (len(BUCKET_BOUNDS) + 1)
+    total = 0
+    mn: Optional[float] = None
+    mx: Optional[float] = None
+    for h in hists:
+        if not h.count:
+            continue
+        total += h.count
+        for i, c in enumerate(h.bucket_counts):
+            counts[i] += c
+        mn = h.min if mn is None else min(mn, h.min)
+        mx = h.max if mx is None else max(mx, h.max)
+    return quantile_from_buckets(counts, total, mn, mx, q)
 
 
 def _key(name: str, labels: Dict[str, object]) -> _Key:
@@ -120,8 +202,10 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
-    """count/sum/min/max/last of observed host scalars — O(1) state, no
-    stored samples (the flight recorder keeps the recent raw series)."""
+    """count/sum/min/max/last + fixed log-bucket counts of observed host
+    scalars — O(1) state, no stored samples (the flight recorder keeps
+    the recent raw series).  ``quantile(q)`` estimates from the buckets
+    (within one bucket width of exact — see :data:`BUCKET_BOUNDS`)."""
 
     kind = "histogram"
 
@@ -137,6 +221,7 @@ class Histogram(_Metric):
             self.min = v
         if self.max is None or v > self.max:
             self.max = v
+        self.bucket_counts[bisect_left(BUCKET_BOUNDS, v)] += 1
 
     def reset(self) -> None:
         self.count = 0
@@ -144,8 +229,29 @@ class Histogram(_Metric):
         self.last: Optional[float] = None
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self.bucket_counts: List[int] = [0] * (len(BUCKET_BOUNDS) + 1)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-estimated quantile (None when empty)."""
+        return quantile_from_buckets(self.bucket_counts, self.count,
+                                     self.min, self.max, q)
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """``[(le, cumulative_count), ...]`` ending with ``(inf, count)``
+        — the Prometheus ``_bucket{le=...}`` series, ready to render."""
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for le, c in zip(BUCKET_BOUNDS, self.bucket_counts):
+            cum += c
+            out.append((le, cum))
+        out.append((float("inf"), self.count))
+        return out
 
     def sample(self) -> Dict[str, float]:
+        # legacy flat suffix keys, unchanged for existing consumers
+        # (bench window deltas, tests asserting .count/.last); bucket
+        # counts are NOT flattened here — obs/export.py renders them
+        # from the registry as proper _bucket exposition instead.
         out = {f"{self.flat}.count": float(self.count),
                f"{self.flat}.sum": float(self.sum)}
         if self.count:
@@ -208,6 +314,15 @@ class MetricsRegistry:
 
     # -- queries -----------------------------------------------------------
 
+    def histograms(self, name: Optional[str] = None) -> List[Histogram]:
+        """Every registered Histogram (optionally filtered by metric
+        name across all label sets) — the exporter renders ``_bucket``
+        series from these, and the fleet server merges a family's
+        buckets for aggregate p50/p95/p99."""
+        return [m for m in list(self._metrics.values())
+                if isinstance(m, Histogram)
+                and (name is None or m.name == name)]
+
     def snapshot(self) -> Dict[str, float]:
         """One flat dict of every metric + every live collector's view."""
         out: Dict[str, float] = {}
@@ -264,6 +379,10 @@ def gauge(name: str, **labels) -> Gauge:
 
 def histogram(name: str, **labels) -> Histogram:
     return REGISTRY.histogram(name, **labels)
+
+
+def histograms(name: Optional[str] = None) -> List[Histogram]:
+    return REGISTRY.histograms(name)
 
 
 def snapshot() -> Dict[str, float]:

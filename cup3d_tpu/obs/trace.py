@@ -50,6 +50,20 @@ closed capture window with the device-time attribution:
      "total_device_ms": float,
      "device_sections": {section: ms}, "other_ms": float, "source": str}
 
+Round 16 adds a second aux kind — the fleet job-lifecycle record, one
+per job at its terminal transition (``fleet/server.py``):
+
+    {"schema": 2, "kind": "job", "step": int,      # steps completed
+     "job_id": str, "tenant": str, "status": str,  # done/failed/cancelled
+     "events": [[name, t], ...]}                   # monotonic seconds,
+                                                   # non-decreasing t
+
+and pid-3 lane-occupancy tracks (:data:`LANE_PID`) in the Perfetto
+export: one X span per job per lane, laid out next to the pid-1 host
+spans and pid-2 device sections.  Every lifecycle timestamp is
+:func:`now` — host ``perf_counter`` on the sink's epoch, taken only at
+lifecycle seams; nothing here reads a device value.
+
 The metrics hot path guarantee: nothing in this module reads a device
 value — every recorded number is a host scalar the caller already had
 (lint rules JX001/JX006/JX008 and the transfer guard enforce it).
@@ -80,6 +94,76 @@ STEP_REQUIRED = {"schema": int, "step": int, "t": float, "dt": float,
 #: required keys of a kind="device" auxiliary record (obs/profile.py)
 DEVICE_REQUIRED = {"schema": int, "step": int, "total_device_ms": float,
                    "device_sections": dict}
+
+#: required keys of a kind="job" auxiliary record (fleet/server.py)
+JOB_REQUIRED = {"schema": int, "step": int, "job_id": str, "tenant": str,
+                "status": str, "events": list}
+
+#: the job-lifecycle span catalog (README "Serving observability"):
+#: every event name a FleetJob timeline may carry, in nominal order —
+#: rollback/retire interleave per lane fault, terminal status last
+JOB_EVENTS = ("submitted", "queued", "bucketed", "running", "dispatched",
+              "fanout", "rollback", "retire",
+              "done", "failed", "cancelled")
+
+#: Perfetto pid of the per-lane job-occupancy tracks (pid 1 = host
+#: spans, pid 2 = obs.profile.DEVICE_PID device sections)
+LANE_PID = 3
+
+
+def now() -> float:
+    """Monotonic lifecycle timestamp: ``perf_counter`` seconds on the
+    same clock as the trace epoch.  The sanctioned primitive for
+    ``fleet/`` lifecycle seams — JX008 keeps ad-hoc ``perf_counter``
+    out of the package and JX014 bans wall-clock subtraction, so every
+    duration in the job observatory derives from THIS clock."""
+    return time.perf_counter()
+
+
+def job_record(job_id: str, tenant: str, status: str, steps_done: int,
+               events, **extra) -> dict:
+    """Build one kind="job" aux record (the sink's ``aux()`` stamps the
+    schema).  ``events`` is an iterable of (name, t) pairs in append
+    order — validation requires t non-decreasing."""
+    rec = {"kind": "job", "step": int(steps_done), "job_id": str(job_id),
+           "tenant": str(tenant), "status": str(status),
+           "events": [[str(n), float(t)] for n, t in events]}
+    rec.update(extra)
+    return rec
+
+
+def _validate_job_record(rec: dict) -> List[str]:
+    """Schema-check one kind="job" auxiliary record."""
+    problems = []
+    for k, typ in JOB_REQUIRED.items():
+        if k not in rec:
+            problems.append(f"missing required key {k!r}")
+        elif not isinstance(rec[k], typ) or isinstance(rec[k], bool):
+            problems.append(f"{k!r} must be {typ.__name__}")
+    if not problems and rec["schema"] != SCHEMA_VERSION:
+        problems.append(
+            f"schema {rec['schema']} != supported {SCHEMA_VERSION}"
+        )
+    if not problems and rec["step"] < 0:
+        problems.append("step must be >= 0")
+    if problems:
+        return problems
+    prev_t = None
+    for ev in rec["events"]:
+        if (not isinstance(ev, (list, tuple)) or len(ev) != 2
+                or not isinstance(ev[0], str)
+                or not isinstance(ev[1], (int, float))
+                or isinstance(ev[1], bool)):
+            problems.append(f"event {ev!r} must be [name, t]")
+            break
+        if prev_t is not None and ev[1] < prev_t:
+            problems.append(
+                f"event {ev[0]!r}: t {ev[1]} < previous {prev_t} "
+                "(timeline must be non-decreasing)"
+            )
+            break
+        prev_t = ev[1]
+    return problems
 
 
 def _validate_device_record(rec: dict) -> List[str]:
@@ -120,12 +204,15 @@ def validate_step_record(rec: dict) -> List[str]:
     """Schema-check one trace record; returns a list of problems (empty
     = valid).  Shared by the sink (debug), tests, and trace_check.
     Dispatches on the v2 ``kind`` tag: absent/"step" is a step record,
-    "device" a capture-window attribution record."""
+    "device" a capture-window attribution record, "job" a fleet
+    job-lifecycle record."""
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not dict"]
     kind = rec.get("kind", "step")
     if kind == "device":
         return _validate_device_record(rec)
+    if kind == "job":
+        return _validate_job_record(rec)
     if kind != "step":
         return [f"unknown record kind {kind!r}"]
     problems = []
@@ -255,6 +342,7 @@ class TraceSink:
         self.steps_recorded = 0
         self.steps_dropped = 0
         self._writer: Optional[_AsyncLineWriter] = None
+        self._lane_meta_emitted = False
         self._lock = threading.Lock()
         # round-13 satellite: the TraceAnnotation class resolves ONCE at
         # construction/configure time, so the span hot path is a single
@@ -282,6 +370,7 @@ class TraceSink:
         self.events.clear()
         self.steps_recorded = 0
         self.steps_dropped = 0
+        self._lane_meta_emitted = False
         self._annotation_cls = self._resolve_annotation()
         return self
 
@@ -336,6 +425,44 @@ class TraceSink:
             "args": record,
         })
         _metrics.counter("trace.steps").inc()
+
+    def _ensure_lane_meta(self) -> None:
+        if not self._lane_meta_emitted:
+            self._lane_meta_emitted = True
+            self.events.append({
+                "name": "process_name", "ph": "M", "pid": LANE_PID,
+                "ts": 0, "args": {"name": "fleet lanes"},
+            })
+
+    def lane_span(self, tid: int, name: str, t0: float, dur: float,
+                  args: Optional[dict] = None) -> None:
+        """One closed per-lane job-occupancy span on the pid-3 track
+        (``t0``/``dur`` in :func:`now` seconds).  ``tid`` is the lane's
+        stable track id; ``name`` carries the job id so Perfetto labels
+        the occupancy bar.  Emits the pid-3 ``process_name`` metadata
+        event once per sink."""
+        if not self.enabled:
+            return
+        self._ensure_lane_meta()
+        self.events.append({
+            "name": name, "ph": "X", "pid": LANE_PID, "tid": int(tid),
+            "ts": (t0 - self.epoch) * 1e6, "dur": dur * 1e6,
+            "args": dict(args or {}),
+        })
+        _metrics.counter("trace.lane_spans").inc()
+
+    def lane_instant(self, tid: int, name: str, t: float,
+                     args: Optional[dict] = None) -> None:
+        """One instant marker on a pid-3 lane track (rollback/retire
+        ticks inside a job's occupancy bar)."""
+        if not self.enabled:
+            return
+        self._ensure_lane_meta()
+        self.events.append({
+            "name": name, "ph": "i", "pid": LANE_PID, "tid": int(tid),
+            "ts": (t - self.epoch) * 1e6, "s": "t",
+            "args": dict(args or {}),
+        })
 
     def aux(self, record: dict) -> None:
         """One kind-tagged auxiliary JSONL record interleaved with the

@@ -583,6 +583,96 @@ def test_jx013_host_only_lane_loops_are_clean():
                    for v in _failing(other_axis, FLEET))
 
 
+def test_jx014_wallclock_duration_fires_and_suppresses():
+    """Wall-clock subtraction used as a duration (round 16): NTP slews
+    and steps time.time(), so a latency computed from it can go
+    negative and corrupts the SLO histograms."""
+    direct = (
+        "import time\n"
+        "def f(t0):\n"
+        "    return time.time() - t0\n"
+    )
+    vs = _failing(direct)
+    assert _rules(vs) == {"JX014"}
+    assert "monotonic" in vs[0].message
+    # names assigned from wall-clock reads are tainted transitively
+    tainted = (
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.time()\n"
+        "    work()\n"
+        "    t1 = time.time()\n"
+        "    return t1 - t0\n"
+    )
+    assert _rules(_failing(tainted)) == {"JX014"}
+    # `from time import time` leaves a bare name behind; still resolved
+    bare = (
+        "from time import time\n"
+        "def f(start):\n"
+        "    return time() - start\n"
+    )
+    assert _rules(_failing(bare)) == {"JX014"}
+    # datetime.now() differences are the same hazard
+    dt = (
+        "import datetime\n"
+        "def f(prev):\n"
+        "    return datetime.datetime.now() - prev\n"
+    )
+    assert _rules(_failing(dt)) == {"JX014"}
+    # attribute targets taint too (self.t0 = time.time())
+    attr = (
+        "import time\n"
+        "class C:\n"
+        "    def f(self):\n"
+        "        self.t0 = time.time()\n"
+        "        return time.time() - self.t0\n"
+    )
+    assert _rules(_failing(attr)) == {"JX014"}
+    # annotation suppresses with the reason recorded
+    ok = direct.replace(
+        "    return time.time() - t0",
+        "    # jax-lint: allow(JX014, test fixture, not a latency)\n"
+        "    return time.time() - t0",
+    )
+    all_vs = L.lint_source(ok, HOT)
+    assert not L.failing(all_vs)
+    assert any(v.rule == "JX014" and "test fixture" in
+               (v.suppression_reason or "") for v in all_vs)
+
+
+def test_jx014_timestamps_and_monotonic_clocks_are_clean():
+    """time.time() as a TIMESTAMP (no subtraction), constant-offset
+    timestamp arithmetic, and perf_counter durations never fire."""
+    stamp = (
+        "import time\n"
+        "def f():\n"
+        "    return {'wall_time': time.time()}\n"
+    )
+    assert not any(v.rule == "JX014" for v in _failing(stamp))
+    # "an hour ago" is timestamp arithmetic, not a duration
+    offset = (
+        "import time\n"
+        "def f():\n"
+        "    return time.time() - 3600\n"
+    )
+    assert not any(v.rule == "JX014" for v in _failing(offset))
+    # the monotonic clock is the SANCTIONED duration source
+    mono = (
+        "import time\n"
+        "def f(t0):\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    assert not any(v.rule == "JX014" for v in _failing(mono))
+    # scoped to the package: tooling outside cup3d_tpu/ is exempt
+    direct = (
+        "import time\n"
+        "def f(t0):\n"
+        "    return time.time() - t0\n"
+    )
+    assert not any(v.rule == "JX014"
+                   for v in _failing(direct, "tools/fixture.py"))
+
+
 def test_wrapped_annotation_comment_blocks_parse():
     """A multi-line (wrapped) annotation applies to the next code line."""
     src = (

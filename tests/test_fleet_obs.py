@@ -1,0 +1,225 @@
+"""Fleet serving observatory acceptance (VALIDATION.md "Round 16"):
+
+- Job-lifecycle timelines: every drained job leaves a kind="job" trace
+  record whose event sequence is ordered and monotonic across the
+  submit, cancel, and fault paths, plus a pid-3 lane-occupancy span in
+  the Perfetto export carrying the job id.
+- Fault isolation in the observatory: a NaN-faulted lane emits rollback
+  events on ITS timeline; the other lanes' timelines are unchanged.
+- Streaming quantiles: the fixed log-bucket histogram estimates p50/p95
+  within one bucket width (~33%) of the exact sample quantile.
+- Live /metrics: a real HTTP scrape exposes per-tenant cumulative
+  ``_bucket{le=...}`` lines that parse back as conformant histograms.
+- SLO burn rate: a job whose end-to-end latency exceeds the target p99
+  bumps the per-tenant breach counter and a nonzero burn rate.
+"""
+
+import json
+import os
+import tempfile
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cup3d_tpu.fleet.server import DONE, FleetServer
+from cup3d_tpu.obs import export as E
+from cup3d_tpu.obs import metrics as M
+from cup3d_tpu.obs import trace as OT
+from cup3d_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _tgv_spec(**kw):
+    spec = dict(kind="tgv", n=16, nsteps=8, cfl=0.3)
+    spec.update(kw)
+    return spec
+
+
+def _job_records(trace_dir):
+    path = os.path.join(trace_dir, "trace.jsonl")
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    for rec in records:
+        assert not OT.validate_step_record(rec), rec
+    return [r for r in records if r.get("kind") == "job"]
+
+
+@pytest.fixture(scope="module")
+def drained():
+    """One traced drain shared by the timeline + scrape tests: two done
+    tenants, one job cancelled while queued."""
+    td = tempfile.mkdtemp(prefix="cup3d-fleetobs-")
+    OT.TRACE.configure(enabled=True, directory=td)
+    try:
+        srv = FleetServer(workdir=os.path.join(td, "wd"))
+        done_ids = [srv.submit("acme", _tgv_spec(cfl=0.3)),
+                    srv.submit("zeta", _tgv_spec(cfl=0.25))]
+        cancel_id = srv.submit("acme", _tgv_spec(cfl=0.28))
+        assert srv.cancel(cancel_id) is True
+        srv.drain()
+        OT.TRACE.close()  # flush trace.jsonl + write trace.pfto.json
+        yield srv, done_ids, cancel_id, td
+    finally:
+        OT.TRACE.configure(enabled=False)
+
+
+# -- job-lifecycle timelines ------------------------------------------------
+
+
+def test_job_timelines_ordered_and_monotonic(drained):
+    """Done jobs carry the full lifecycle in order; the cancelled job
+    stops at submitted -> queued -> cancelled; timestamps never
+    decrease within a timeline."""
+    srv, done_ids, cancel_id, td = drained
+    jobs = {r["job_id"]: r for r in _job_records(td)}
+    assert set(jobs) == set(done_ids) | {cancel_id}
+    for job_id in done_ids:
+        rec = jobs[job_id]
+        assert rec["status"] == DONE and rec["step"] == 8
+        names = [n for n, _ in rec["events"]]
+        assert names == ["submitted", "queued", "bucketed", "running",
+                         "dispatched", "fanout", "retire", "done"]
+        times = [t for _, t in rec["events"]]
+        assert times == sorted(times)
+        assert rec["bucket"].startswith("tgv-")
+        assert rec["durations"]["e2e_s"] >= rec["durations"]["exec_s"] >= 0
+    cancelled = jobs[cancel_id]
+    assert [n for n, _ in cancelled["events"]] == [
+        "submitted", "queued", "cancelled"]
+
+
+def test_lane_occupancy_tracks_in_perfetto_export(drained):
+    """The merged export grows pid-3 lane tracks: a process_name
+    metadata event, one occupancy span per done job carrying its
+    job id, spans non-overlapping per track — and the trace_check
+    validator accepts the whole artifact."""
+    import subprocess
+    import sys
+
+    srv, done_ids, cancel_id, td = drained
+    with open(os.path.join(td, "trace.pfto.json")) as f:
+        events = json.load(f)["traceEvents"]
+    lane = [e for e in events if e.get("pid") == OT.LANE_PID]
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in lane)
+    spans = [e for e in lane if e["ph"] == "X"]
+    assert {e["args"]["job_id"] for e in spans} == set(done_ids)
+    for e in spans:
+        assert e["dur"] >= 0 and e["args"]["status"] == DONE
+    # the cancelled job never occupied a lane -> no span for it
+    assert cancel_id not in {e["args"]["job_id"] for e in spans}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_check.py"),
+         os.path.join(td, "trace.jsonl")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "job-lifecycle records" in proc.stdout
+
+
+def test_faulted_lane_rolls_back_alone(tmp_path):
+    """A NaN injected into lane 1 puts rollback events on THAT job's
+    timeline; lane 0's timeline shows none and both jobs complete."""
+    td = str(tmp_path)
+    OT.TRACE.configure(enabled=True, directory=td)
+    try:
+        faults.arm("fleet.lane_nan", 1, 1)
+        srv = FleetServer(workdir=os.path.join(td, "wd"), snap_every=4)
+        ids = [srv.submit("t0", _tgv_spec(cfl=0.3, nsteps=12)),
+               srv.submit("t1", _tgv_spec(cfl=0.28, nsteps=12))]
+        srv.drain()
+        OT.TRACE.close()
+    finally:
+        OT.TRACE.configure(enabled=False)
+    jobs = {r["job_id"]: r for r in _job_records(td)}
+    clean = [n for n, _ in jobs[ids[0]]["events"]]
+    faulted = [n for n, _ in jobs[ids[1]]["events"]]
+    assert "rollback" in faulted and faulted[-1] == DONE
+    assert "rollback" not in clean
+    assert clean == ["submitted", "queued", "bucketed", "running",
+                     "dispatched", "fanout", "retire", "done"]
+    assert jobs[ids[1]]["step"] == 12  # recovered and finished
+
+
+# -- streaming quantiles ----------------------------------------------------
+
+
+def test_quantile_estimates_within_one_bucket_width():
+    """The log-ladder guarantee: 8 buckets/decade puts any estimate
+    within one bucket width (a 10^(1/8) ~ 1.33x factor) of the exact
+    sample quantile; min/max are exact at the extremes."""
+    h = M.histogram("t16.quant", case="ladder")
+    vals = [0.0013 * (i + 1) for i in range(1000)]  # 1.3 ms .. 1.3 s
+    for v in vals:
+        h.observe(v)
+    width = 10.0 ** (1.0 / M.BUCKETS_PER_DECADE)
+    for q in (0.5, 0.9, 0.95, 0.99):
+        exact = float(np.quantile(vals, q))
+        est = h.quantile(q)
+        assert exact / width <= est <= exact * width, (q, est, exact)
+    assert min(vals) <= h.quantile(0.0) <= min(vals) * width
+    assert max(vals) / width <= h.quantile(1.0) <= max(vals)
+
+
+# -- live /metrics scrape ---------------------------------------------------
+
+
+def test_metrics_scrape_exposes_per_tenant_buckets(drained):
+    """A real HTTP scrape: per-tenant fleet.job_e2e_s renders as a
+    conformant histogram family (cumulative le buckets, _sum, _count)
+    and round-trips through parse_histograms."""
+    srv, done_ids, _, _ = drained
+    ex = E.MetricsExporter(port=0).start()
+    try:
+        body = urllib.request.urlopen(ex.url + "/metrics").read().decode()
+    finally:
+        ex.stop()
+    assert 'le="+Inf"' in body
+    fams = E.parse_histograms(body)
+    for tenant in ("acme", "zeta"):
+        keys = [k for k in fams
+                if k[0] == "cup3d_fleet_job_e2e_s"
+                and ("tenant", tenant) in k[1]]
+        assert keys, (tenant, sorted(fams))
+        fam = fams[keys[0]]
+        assert fam["count"] >= 1 and fam["sum"] >= 0
+        cums = [c for _, c in fam["buckets"]]
+        assert cums == sorted(cums)  # cumulative, ending at +Inf=count
+        assert fam["buckets"][-1][0] == float("inf")
+        assert fam["buckets"][-1][1] == fam["count"]
+    # the legacy flat keys stay in snapshot() for existing consumers
+    snap = M.snapshot()
+    assert any(k.startswith("fleet.job_e2e_s{") and k.endswith(".count")
+               for k in snap)
+
+
+# -- SLO burn rate ----------------------------------------------------------
+
+
+def test_burn_rate_fires_when_latency_exceeds_slo(tmp_path):
+    """With the target p99 forced below any real drain latency, every
+    job breaches: the per-tenant breach counter fires and slo_status
+    reports a nonzero burn rate; /health carries the block."""
+    s0 = M.snapshot()
+    srv = FleetServer(workdir=str(tmp_path), slo_p99_s=1e-6,
+                      slo_window=10)
+    srv.submit("burny", _tgv_spec(cfl=0.3))
+    srv.drain()
+    d = M.delta(s0)
+    assert d.get("fleet.slo_breaches{tenant=burny}", 0) == 1
+    slo = srv.slo_status()
+    assert slo["target_p99_s"] == pytest.approx(1e-6)
+    burny = slo["tenants"]["burny"]
+    assert burny["jobs"] == 1 and burny["breaches"] == 1
+    assert burny["breach_fraction"] == 1.0
+    assert burny["burn_rate"] == pytest.approx(1.0 / srv.SLO_ERROR_BUDGET)
+    assert burny["quantiles"]["p99"] > 1e-6
+    health = srv.health()
+    assert health["slo"]["tenants"]["burny"]["breaches"] == 1

@@ -1,8 +1,9 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX013
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX014
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
 # swallowed-exception, JX011 bf16-reduction-accumulator, JX012
-# profiler-outside-obs and JX013 per-lane-loop rules)
+# profiler-outside-obs, JX013 per-lane-loop and JX014
+# wall-clock-duration rules)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
 # + the obs trace schema selftest (tools/trace_check.py), the
 # device-attribution parser selftest (obs/profile.py), the bench-
@@ -51,6 +52,13 @@ python -m cup3d_tpu.analysis --rules JX012 $PATHS -q
 # identifiably — the lane axis must stay vectorized (vmap)
 echo "== python -m cup3d_tpu.analysis --rules JX013 cup3d_tpu/fleet"
 python -m cup3d_tpu.analysis --rules JX013 cup3d_tpu/fleet -q
+
+# the wall-clock-duration rule on its own line (round 16): a
+# time.time()/datetime.now() subtraction masquerading as a latency in
+# the SLO/histogram path fails CI identifiably — durations come from
+# the monotonic clock (obs.trace.now())
+echo "== python -m cup3d_tpu.analysis --rules JX014 $PATHS"
+python -m cup3d_tpu.analysis --rules JX014 $PATHS -q
 
 # fused-kernel smoke (round 12): the interpret-mode selftest exercises
 # every Pallas stage of the fused BiCGSTAB driver without a TPU

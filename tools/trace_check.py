@@ -13,15 +13,23 @@ Checks, per ``cup3d_tpu.obs.trace`` schema version %d:
 - every line parses as JSON and passes ``validate_step_record``
   (required keys, types, schema version, non-negative steps) — v2
   ``kind="device"`` auxiliary records (obs/profile.py capture-window
-  attributions) validate against their own required-key set;
-- step indices are non-decreasing across step AND device records;
+  attributions) and ``kind="job"`` records (fleet/server.py job
+  lifecycles, round 16) validate against their own required-key sets,
+  including non-decreasing per-job event timelines;
+- step indices are non-decreasing across step AND device records
+  (job records are exempt: their ``step`` is the job's own step count,
+  and terminal records land in completion order);
 - the Chrome trace-event export built from the records (plus, when a
   ``trace.pfto.json`` sits next to the input, that file itself) parses
   back and every event carries name/ph/ts, with step spans exposing
   their record in ``args`` — the properties Perfetto needs to load it;
 - a MERGED host+device export (device ops on pid 2, obs/profile.py)
   additionally needs a ``process_name`` metadata event for the device
-  track and a ``section`` attribution on every device op.
+  track and a ``section`` attribution on every device op;
+- per-lane job-occupancy tracks (pid 3, fleet/server.py) need their own
+  ``process_name`` metadata event, a ``job_id`` arg on every occupancy
+  span, and NON-OVERLAPPING spans per lane track — a lane serves one
+  job at a time, so overlap means the emission is lying.
 
 ``--selftest`` (what ``tools/lint.sh`` runs, no simulation needed)
 drives a private TraceSink through spans + step records in a temp dir,
@@ -63,12 +71,16 @@ def validate_jsonl(path: str) -> list:
                 raise SystemExit(
                     f"{path}:{i}: schema violation(s): {problems}"
                 )
-            if rec["step"] < last_step:
-                raise SystemExit(
-                    f"{path}:{i}: step {rec['step']} after {last_step} "
-                    "(records must be non-decreasing in step)"
-                )
-            last_step = rec["step"]
+            if rec.get("kind", "step") != "job":
+                # job records carry the JOB's step count and land in
+                # completion order — only step/device records share the
+                # simulation's monotonic step axis
+                if rec["step"] < last_step:
+                    raise SystemExit(
+                        f"{path}:{i}: step {rec['step']} after {last_step} "
+                        "(records must be non-decreasing in step)"
+                    )
+                last_step = rec["step"]
             records.append(rec)
     if not records:
         raise SystemExit(f"{path}: empty trace")
@@ -86,10 +98,28 @@ def _check_chrome(obj: dict, origin: str, want_steps: int) -> int:
     step_spans = 0
     device_ops = 0
     device_named = False
+    lane_named = False
+    lane_spans = {}  # tid -> [(ts, dur)] job-occupancy spans
     for e in events:
         for k in ("name", "ph", "ts"):
             if k not in e:
                 raise SystemExit(f"{origin}: event missing {k!r}: {e}")
+        if e.get("pid") == obs_trace.LANE_PID:
+            # round 16: per-lane job-occupancy tracks (fleet/server.py)
+            if e["ph"] == "M" and e["name"] == "process_name":
+                lane_named = True
+                continue
+            if e["ph"] != "X":
+                continue  # instants (rollback ticks) need no extra args
+            if "dur" not in e:
+                raise SystemExit(f"{origin}: lane span without dur: {e}")
+            if "job_id" not in e.get("args", {}):
+                raise SystemExit(
+                    f"{origin}: lane span without job_id arg: {e}"
+                )
+            lane_spans.setdefault(e.get("tid"), []).append(
+                (float(e["ts"]), float(e["dur"])))
+            continue
         if e.get("pid") == DEVICE_PID:
             if e["ph"] == "M" and e["name"] == "process_name":
                 device_named = True
@@ -116,6 +146,20 @@ def _check_chrome(obj: dict, origin: str, want_steps: int) -> int:
             f"{origin}: device ops present but no process_name metadata "
             f"for pid {DEVICE_PID}"
         )
+    if lane_spans and not lane_named:
+        raise SystemExit(
+            f"{origin}: lane spans present but no process_name metadata "
+            f"for pid {obs_trace.LANE_PID}"
+        )
+    for tid, spans in lane_spans.items():
+        spans.sort()
+        for (ts0, dur0), (ts1, _) in zip(spans, spans[1:]):
+            if ts1 < ts0 + dur0:
+                raise SystemExit(
+                    f"{origin}: overlapping job spans on lane track "
+                    f"{tid}: [{ts0}, {ts0 + dur0}) then {ts1} — a lane "
+                    "serves one job at a time"
+                )
     if step_spans < want_steps:
         raise SystemExit(
             f"{origin}: {step_spans} step spans < {want_steps} records"
@@ -128,23 +172,24 @@ def roundtrip_chrome(records: list, jsonl_path: str) -> None:
     re-parse, check; then check the sibling trace.pfto.json when
     present (which may carry a merged device track)."""
     steps = [r for r in records if r.get("kind", "step") == "step"]
-    sink = obs_trace.TraceSink(enabled=True,
-                               directory=tempfile.mkdtemp())
-    t = 0.0
-    for rec in steps:
-        sink.events.append({
-            "name": "step", "ph": "X", "pid": 1, "tid": 0,
-            "ts": t * 1e6, "dur": rec["wall_s"] * 1e6, "args": rec,
-        })
-        t += rec["wall_s"]
-        sink.steps_recorded += 1
-    blob = json.dumps(sink.chrome_trace())
-    _check_chrome(json.loads(blob), "<rebuilt export>", len(steps))
+    if steps:  # a fleet-only trace may hold job records alone
+        sink = obs_trace.TraceSink(enabled=True,
+                                   directory=tempfile.mkdtemp())
+        t = 0.0
+        for rec in steps:
+            sink.events.append({
+                "name": "step", "ph": "X", "pid": 1, "tid": 0,
+                "ts": t * 1e6, "dur": rec["wall_s"] * 1e6, "args": rec,
+            })
+            t += rec["wall_s"]
+            sink.steps_recorded += 1
+        blob = json.dumps(sink.chrome_trace())
+        _check_chrome(json.loads(blob), "<rebuilt export>", len(steps))
     sibling = os.path.join(os.path.dirname(jsonl_path) or ".",
                            "trace.pfto.json")
     if os.path.exists(sibling):
         with open(sibling) as f:
-            _check_chrome(json.load(f), sibling, 1)
+            _check_chrome(json.load(f), sibling, 1 if steps else 0)
 
 
 def selftest() -> None:
@@ -194,7 +239,54 @@ def selftest() -> None:
         with open(os.path.join(td, "trace.pfto.json")) as f:
             dev_ops = _check_chrome(json.load(f), "<merged export>", 3)
         assert dev_ops == len(attr.events), (dev_ops, len(attr.events))
-    print("trace_check selftest: OK (incl. merged host+device)")
+    # round 16: the serving observatory — kind="job" aux records plus
+    # pid-3 lane-occupancy tracks produced through the same sink APIs
+    # fleet/server.py uses must validate end to end
+    with tempfile.TemporaryDirectory() as td:
+        sink = obs_trace.TraceSink(enabled=True, directory=td)
+        timer = obs_trace.SpanTimer(sink=sink)
+        obsr = obs_trace.StepObserver(timer, kind="selftest")
+        with obsr.step(0, 0.0, 0.1):
+            pass
+        t0 = obs_trace.now()
+        for lane, (jid, status) in enumerate(
+                (("job-0", "done"), ("job-1", "failed"))):
+            events = [("submitted", t0), ("queued", t0 + 0.001),
+                      ("running", t0 + 0.002), ("rollback", t0 + 0.004),
+                      (status, t0 + 0.01 + lane * 0.01)]
+            sink.aux(obs_trace.job_record(
+                jid, "tenant-a", status, 8, events, bucket="tgv-abc"))
+            sink.lane_span(lane, jid, t0 + 0.002,
+                           0.008 + lane * 0.01,
+                           args={"job_id": jid, "status": status})
+            sink.lane_instant(lane, "rollback", t0 + 0.004,
+                              args={"job_id": jid})
+        # back-to-back jobs on ONE lane track must not overlap
+        sink.lane_span(0, "job-2", t0 + 0.02, 0.005,
+                       args={"job_id": "job-2", "status": "done"})
+        sink.close()
+        records = validate_jsonl(os.path.join(td, "trace.jsonl"))
+        jobs = [r for r in records if r.get("kind") == "job"]
+        assert len(jobs) == 2, [r.get("kind") for r in records]
+        assert {j["status"] for j in jobs} == {"done", "failed"}
+        with open(os.path.join(td, "trace.pfto.json")) as f:
+            merged = json.load(f)
+        _check_chrome(merged, "<lane export>", 1)
+        # and the overlap check has teeth: shifting the second job-0
+        # span under the first must fail
+        bad = json.loads(json.dumps(merged))
+        for e in bad["traceEvents"]:
+            if e.get("pid") == obs_trace.LANE_PID and e["ph"] == "X" \
+                    and e["name"] == "job-2":
+                e["ts"] -= 18000.0  # back into job-0's occupancy bar
+        try:
+            _check_chrome(bad, "<overlap probe>", 1)
+        except SystemExit as e:
+            assert "overlapping job spans" in str(e), e
+        else:
+            raise AssertionError("overlapping lane spans not caught")
+    print("trace_check selftest: OK (incl. merged host+device, "
+          "job records + lane tracks)")
 
 
 def main(argv=None) -> int:
@@ -230,10 +322,12 @@ def main(argv=None) -> int:
         sink.export_chrome(args.perfetto)
     with_solver = sum(1 for r in records if "solver" in r)
     devices = sum(1 for r in records if r.get("kind") == "device")
+    jobs = sum(1 for r in records if r.get("kind") == "job")
     print(f"trace_check: OK — {len(records)} records "
           f"(steps {records[0]['step']}..{records[-1]['step']}, "
           f"{with_solver} with solver stats, "
-          f"{devices} device-attribution records)")
+          f"{devices} device-attribution records, "
+          f"{jobs} job-lifecycle records)")
     return 0
 
 
