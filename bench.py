@@ -30,8 +30,8 @@ the reference's favor: real 64-rank runs lose efficiency to halo
 traffic and Krylov allreduces).  Raw records:
 validation/results/baseline.jsonl.
 
-Env knobs: CUP3D_BENCH_CONFIG=fish|tgv|spectral|amr|fleet|fleet_slo|all
-(default all),
+Env knobs: CUP3D_BENCH_CONFIG=fish|tgv|spectral|amr|fleet|fleet_slo|
+fleet_skew|all (default all),
 CUP3D_BENCH_N (downscale resolutions for CPU smoke testing),
 CUP3D_BENCH_PROFILE=<dir> (capture a jax.profiler trace of the timed
 region of each config for TensorBoard / xprof).
@@ -1388,10 +1388,131 @@ def bench_fleet_slo():
     }
 
 
+def bench_fleet_skew():
+    """Round-17 continuous-batching config: a seeded heavy-tailed job
+    mix (mostly short tgv jobs, a fat tail of 8x-longer ones) served
+    twice through two-lane fleets — once by the work-conserving
+    continuous scheduler (serve() with in-flight submission, freed
+    lanes reseeded at K-boundaries) and once by the legacy generation
+    drain (CUP3D_FLEET_CONTINUOUS=0, submit-one-drain-one: the
+    convoy pattern continuous batching exists to kill).
+
+    The gate is ``fleet.lane_occupancy`` — busy-lane-steps over
+    total-lane-steps for the measured window — at EQUAL results: both
+    runs must complete every job with identical step counts and
+    matching final sim times.  The legacy baseline pads every
+    single-job batch to the 2-lane rung, so its occupancy is exactly
+    0.5 by construction; the continuous run keeps the short-job lane
+    turning over beside the long jobs and must land >= 1.5x the
+    baseline.  The SEED fixes the mix, so the ratio is a scheduling
+    property, not arrival luck; ``fleet_reseeds`` records how many
+    boundary reseeds did the work."""
+    import random
+    import tempfile
+
+    from cup3d_tpu.fleet.server import FleetServer
+
+    lanes = int(os.environ.get("CUP3D_BENCH_SKEW_LANES", "2"))
+    njobs = int(os.environ.get("CUP3D_BENCH_SKEW_JOBS", "12"))
+    n = _scaled(16)
+    rng = random.Random(1717)
+    steps = [8 if rng.random() < 0.75 else 64 for _ in range(njobs)]
+    if 64 not in steps:  # the tail is the point; seed-proof it
+        steps[-1] = 64
+
+    def spec(s):
+        return dict(kind="tgv", n=n, nsteps=s, cfl=0.3)
+
+    def warmed(server):
+        # prime BOTH step-budget rungs of the shared static signature
+        # into the executable cache, under a tenant the measured
+        # equal-results check ignores
+        for s in sorted(set(steps)):
+            server.submit("warmup", spec(s))
+        server.drain()
+        return server
+
+    # continuous: trickle arrivals through serve() admission — the
+    # feed keeps at most two jobs queued, so every lane freed by a
+    # short job retiring has fresh same-signature work to reseed
+    srv = warmed(FleetServer(
+        max_lanes=lanes, snap_every=10**9, continuous=True,
+        workdir=tempfile.mkdtemp(prefix="cup3d-benchskew-")))
+    reseeds0, pending, cont_ids = srv.reseeds, list(steps), []
+
+    def feed(server, tick):
+        while pending and server.queue_depth() < 2:
+            cont_ids.append(server.submit("skew", spec(pending.pop(0))))
+        return bool(pending)
+
+    # jax-lint: allow(JX006, serve() settles every batch stream before
+    # returning — all lane-step QoI rows are host-read in the window)
+    t0 = time.perf_counter()
+    srv.serve(feed)
+    # jax-lint: allow(JX006, serve() above settled every dispatch)
+    wall = time.perf_counter() - t0
+    occ_cont = float(srv.last_occupancy or 0.0)
+    reseeds = int(srv.reseeds - reseeds0)
+    cont_jobs = [srv._jobs[j] for j in cont_ids]
+
+    # legacy baseline: same seeded stream, one job per generation —
+    # every batch pads to the 2-lane rung around a single active lane
+    leg = warmed(FleetServer(
+        max_lanes=lanes, snap_every=10**9, continuous=False,
+        workdir=tempfile.mkdtemp(prefix="cup3d-benchskew-leg-")))
+    busy0, total0 = leg._occupancy_totals()
+    # jax-lint: allow(JX006, every drain() settles the batch stream —
+    # all lane-step QoI rows are host-read before the window closes)
+    t0 = time.perf_counter()
+    leg_ids = []
+    for s in steps:
+        leg_ids.append(leg.submit("skew", spec(s)))
+        leg.drain()
+    # jax-lint: allow(JX006, the drain() loop above settled every
+    # dispatch)
+    drain_wall = time.perf_counter() - t0
+    busy1, total1 = leg._occupancy_totals()
+    occ_drain = (busy1 - busy0) / max(total1 - total0, 1)
+    leg_jobs = [leg._jobs[j] for j in leg_ids]
+
+    # equal results: both schedulers finish every job, step for step,
+    # at matching final sim times — occupancy gains that change the
+    # physics would be cheating
+    equal = (
+        all(j.status == "done" for j in cont_jobs + leg_jobs)
+        and [j.steps_done for j in cont_jobs]
+        == [j.steps_done for j in leg_jobs] == steps
+        and all(np.isclose(a.time, b.time, rtol=1e-10, atol=1e-12)
+                for a, b in zip(cont_jobs, leg_jobs))
+    )
+
+    ratio = occ_cont / max(occ_drain, 1e-9)
+    gate = 1.5
+    ok = bool(equal and ratio >= gate)
+    return {
+        "cells_per_s": sum(steps) * n**3 / wall,
+        "fleet_occupancy": round(occ_cont, 4),
+        "fleet_occupancy_drain": round(occ_drain, 4),
+        "fleet_occupancy_ratio": round(ratio, 3),
+        "fleet_reseeds": reseeds,
+        "jobs": njobs,
+        "nsteps_mix": steps,
+        "mix_seed": 1717,
+        "lanes": lanes,
+        "equal_results": bool(equal),
+        "wall_continuous_s": round(wall, 3),
+        "wall_drain_s": round(drain_wall, 3),
+        "fleet_occupancy_gate": gate,
+        "fleet_occupancy_gate_ok": ok,
+        "n": n,
+    }
+
+
 def main():
     which = os.environ.get("CUP3D_BENCH_CONFIG", "all")
     if which not in ("fish", "fish256", "tgv", "spectral", "amr",
-                     "channel", "amr_tgv", "fleet", "fleet_slo", "all"):
+                     "channel", "amr_tgv", "fleet", "fleet_slo",
+                     "fleet_skew", "all"):
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "vs_baseline": 0,
                           "error": f"unknown CUP3D_BENCH_CONFIG {which!r}"}))
@@ -1429,11 +1550,13 @@ def main():
         ("amr_tgv", bench_amr_tgv),
         ("fleet32", bench_fleet32),
         ("fleet_slo", bench_fleet_slo),
+        ("fleet_skew", bench_fleet_skew),
     ):
         sel = {"fish256": None, "tgv_iterative": "tgv",
                "spectral": "spectral", "two_fish_amr": "amr",
                "channel": "channel", "amr_tgv": "amr_tgv",
-               "fleet32": "fleet", "fleet_slo": "fleet_slo"}[key]
+               "fleet32": "fleet", "fleet_slo": "fleet_slo",
+               "fleet_skew": "fleet_skew"}[key]
         if which != "all" and which != sel:
             continue
         try:
@@ -1554,6 +1677,18 @@ def _compact_summary(out: dict) -> dict:
                 "ratio": d.get("fleet_amortization_ratio"),
                 "gate": d.get("fleet_amortization_gate"),
                 "ok": d["fleet_amortization_gate_ok"],
+            }
+        if "fleet_occupancy_gate_ok" in d:
+            # the round-17 acceptance bar: continuous batching holds
+            # >= 1.5x the generation-drain lane occupancy on the
+            # seeded heavy-tailed mix, at equal per-job results
+            gates["fleet_occupancy"] = {
+                "occupancy": d.get("fleet_occupancy"),
+                "drain": d.get("fleet_occupancy_drain"),
+                "ratio": d.get("fleet_occupancy_ratio"),
+                "reseeds": d.get("fleet_reseeds"),
+                "gate": d.get("fleet_occupancy_gate"),
+                "ok": d["fleet_occupancy_gate_ok"],
             }
         if "fleet_slo_p99_gate_ok" in d:
             # the round-16 acceptance bar: every job of the seeded

@@ -74,6 +74,16 @@ Rule summary (full rationale in ``analysis/rules.py``):
          monotonic clock (``obs.trace.now()`` / obs spans); bare
          ``time.time()`` TIMESTAMPS (history rows, postmortem
          wall_time) stay legal — only the subtraction fires.
+- JX015  per-tick host reassembly of full-batch arrays in
+         ``cup3d_tpu/fleet/``: a K-boundary fast-path function
+         (tick/reseed/dispatch) that restacks the whole lane axis
+         (``jnp.stack``/``np.stack``/``concatenate`` or the assembly
+         helpers ``stack_carries``/``stack_gaits``) pays O(B) host
+         work and a fresh device upload every boundary — a reseed
+         must touch ONE lane through the jitted ``.at[lane].set``
+         upload path (``fleet/batch.py reseed_lane_carry``).  Batch
+         CONSTRUCTION (assemble/__init__) still stacks legitimately:
+         the rule keys on the per-tick function names.
 """
 
 from __future__ import annotations
@@ -168,6 +178,18 @@ JX011_ACCUM_KWARGS = frozenset({"dtype", "preferred_element_type"})
 #: module's own names are resolved per file from its imports, since
 #: ``from time import time`` leaves a bare ``time()`` call behind
 JX014_DATETIME_ATTRS = frozenset({"now", "utcnow", "today"})
+
+#: JX015 scope: the fleet K-boundary fast path — functions named like
+#: the per-tick seam (tick/reseed/dispatch), where full-batch
+#: reassembly turns an O(1)-lane reseed into O(B) host work per tick
+JX015_FUNC_RE = re.compile(r"(^|_)(ticks?|reseeds?|dispatch(es)?)",
+                           re.IGNORECASE)
+
+#: callables that rebuild the full lane-stacked batch from per-lane
+#: pieces: array stackers (resolved against jnp/np roots) plus this
+#: repo's own assembly helpers, which stack by construction
+JX015_STACKERS = frozenset({"stack", "concatenate", "vstack", "hstack"})
+JX015_ASSEMBLY_HELPERS = frozenset({"stack_carries", "stack_gaits"})
 
 
 def _is_host_metadata(expr: ast.AST) -> bool:
@@ -435,6 +457,7 @@ class FileLint:
                 self._check_bf16_reduction(func, qualname)  # JX011
             if JX013_MODULE_RE.search(self.path):
                 self._check_lane_device_loop(func, qualname)  # JX013
+                self._check_batch_reassembly(func, qualname)  # JX015
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         self._check_wallclock_duration(self.tree, "<module>")  # JX014
@@ -1172,6 +1195,48 @@ class FileLint:
                         "jnp.where selects)",
                     )
                     break
+
+    # -- JX015 -------------------------------------------------------------
+
+    def _check_batch_reassembly(self, func: ast.AST, qualname: str) -> None:
+        """Full-batch host reassembly on the per-tick fleet fast path
+        (JX015, fleet/ only).  Fires inside functions named like the
+        K-boundary seam (JX015_FUNC_RE: tick/reseed/dispatch) on calls
+        that restack the whole lane axis — ``jnp.stack``/``np.stack``/
+        ``concatenate`` (any jnp/np/jax/lax root) or the assembly
+        helpers ``stack_carries``/``stack_gaits`` under any dotted
+        prefix.  A reseed must replace ONE lane through the jitted
+        ``.at[lane].set`` upload (fleet/batch.py reseed_lane_carry /
+        reseed_lane_gaits); rebuilding the B-lane pytree host-side
+        every boundary is O(B) host work plus a full re-upload, and it
+        breaks the bitwise-untouched guarantee for the other B-1
+        lanes.  Batch construction (assemble/__init__) stacks
+        legitimately and never matches the function-name gate."""
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if not JX015_FUNC_RE.search(func.name):
+            return
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in JX015_ASSEMBLY_HELPERS:
+                pass  # repo helpers stack by construction, any prefix
+            elif leaf in JX015_STACKERS:
+                root = name.split(".", 1)[0].lstrip("_")
+                if "." not in name or root not in (
+                        "jnp", "jax", "lax", "np", "numpy"):
+                    continue  # bare/unknown-root stack(): not an array op
+            else:
+                continue
+            self._emit(
+                "JX015", node, qualname,
+                f"`{name}()` reassembles the full lane-stacked batch "
+                "inside a per-tick path; replace one lane via the "
+                "jitted `.at[lane].set` upload instead "
+                "(fleet/batch.py reseed_lane_carry/reseed_lane_gaits)",
+            )
 
     # -- JX009 -------------------------------------------------------------
 

@@ -187,6 +187,25 @@ RULES: Dict[str, Rule] = {
             "fires only on wall-clock SUBTRACTION.",
         ),
         Rule(
+            "JX015",
+            "per-tick host reassembly of full-batch arrays in fleet/",
+            "A K-boundary fast-path function (tick/reseed/dispatch) in "
+            "cup3d_tpu/fleet/ that restacks the whole lane axis — "
+            "jnp.stack/np.stack/concatenate or the assembly helpers "
+            "stack_carries/stack_gaits — turns an O(1)-lane reseed "
+            "into O(B) host work plus a full-batch device upload at "
+            "EVERY boundary, and the host-side rebuild breaks the "
+            "round-14 bitwise-untouched guarantee for the other B-1 "
+            "lanes (fresh ndarray round-trips are not bitwise-stable "
+            "across pytrees that were never touched).  The round-17 "
+            "continuous-batching contract is that a reseed replaces "
+            "ONE lane through the jitted `.at[lane].set` upload path "
+            "(fleet/batch.py reseed_lane_carry/reseed_lane_gaits, one "
+            "compiled specialization for all lane indices).  Batch "
+            "CONSTRUCTION (assemble/FleetBatch.__init__) stacks "
+            "legitimately — the rule keys on per-tick function names.",
+        ),
+        Rule(
             "JX012",
             "direct jax.profiler use outside the obs layer",
             "jax.profiler.start_trace/stop_trace/TraceAnnotation called "

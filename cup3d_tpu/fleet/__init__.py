@@ -5,7 +5,8 @@ job serving.
   ``lane`` (scenario) axis, with optional device sharding of the lane
   axis (CUP3D_FLEET_MESH).
 - :mod:`fleet.server` — job queue, capacity-bucketed batch assembly,
-  the dispatch loop, and per-tenant QoI fan-out.
+  the continuous-batching serve loop (work-conserving lane reseeding
+  at K-boundaries, admission control), and per-tenant QoI fan-out.
 - :mod:`fleet.isolate` — per-lane fault isolation: lane-scoped
   rollback with dt-halving; healthy lanes bitwise untouched.
 """
@@ -13,6 +14,8 @@ job serving.
 from cup3d_tpu.fleet.batch import (  # noqa: F401
     build_fleet_advance,
     fleet_mesh,
+    reseed_lane_carry,
+    reseed_lane_gaits,
     stack_carries,
     stack_gaits,
 )
@@ -22,6 +25,7 @@ from cup3d_tpu.fleet.server import (  # noqa: F401
     FAILED,
     QUEUED,
     RUNNING,
+    FleetAdmissionError,
     FleetJob,
     FleetServer,
     live_servers,

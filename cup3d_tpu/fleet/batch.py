@@ -139,6 +139,58 @@ def _gated(core, has_gait):
     return body
 
 
+@jax.jit
+def _upload_lane_carry(carry, lane, solo, nsteps):
+    """One lane's rows of the batched carry <- a solo carry, with the
+    lane's ``left`` budget set to ``nsteps``.  ``lane`` is a traced
+    int32 scalar, so ``.at[lane].set`` lowers to a dynamic_update_slice
+    and every lane index shares ONE compiled specialization — the
+    zero-recompile half of the reseed contract.  jnp's scatter-update
+    writes only the addressed rows: every other lane's bits come
+    through untouched (the round-14 isolation contract extended to
+    reseeding, VALIDATION.md "Round 17")."""
+    out = {}
+    for k, v in carry.items():
+        if k == LEFT:
+            out[k] = v.at[lane].set(nsteps.astype(v.dtype))
+        else:
+            out[k] = v.at[lane].set(solo[k].astype(v.dtype))
+    return out
+
+
+@jax.jit
+def _upload_lane_gait(gaits, lane, gait):
+    return {k: gaits[k].at[lane].set(gait[k]) for k in gaits}
+
+
+def reseed_lane_carry(carry, lane, solo, nsteps):
+    """Splice a fresh job's solo carry into lane ``lane`` of a batched
+    carry (per-lane upload, NOT a host restack): the continuous-batching
+    reseed primitive.  ``solo`` is an init_*_carry output for the same
+    bucket signature; ``nsteps`` becomes the lane's ``left`` budget.
+    Like the rollback selects (fleet/isolate.py) the result is a new
+    carry — the input is not donated, so in-flight consumers of the old
+    buffers stay valid."""
+    solo = {k: jnp.asarray(solo[k]) for k in carry if k != LEFT}
+    return _upload_lane_carry(
+        carry, jnp.asarray(lane, jnp.int32), solo,
+        jnp.asarray(nsteps, jnp.int32))
+
+
+def reseed_lane_gaits(gaits, lane, gait):
+    """Swap one lane's row of the stacked frozen-gait pytree (fish
+    bucket reseed); None passes through for gait-free bodies.  The new
+    gait must share the batch's parameter set and leaf shapes — reseeds
+    are same-signature by construction (fleet/server.py matches on the
+    static signature before calling this)."""
+    if gaits is None:
+        return None
+    if sorted(gait) != sorted(gaits):
+        raise ValueError("reseed gait disagrees with the batch gait set")
+    solo = {k: jnp.asarray(gait[k], gaits[k].dtype) for k in gaits}
+    return _upload_lane_gait(gaits, jnp.asarray(lane, jnp.int32), solo)
+
+
 #: lane-track tid stride: lane tids are ``batch_id * LANE_TID_STRIDE +
 #: lane`` so concurrent batches never share a Perfetto thread track
 #: (the pid-3 job-occupancy export, obs/trace.LANE_PID)

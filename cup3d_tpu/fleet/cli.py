@@ -12,6 +12,11 @@ way but prints the SLO report instead: per-tenant p50/p95/p99 job
 latency (from the obs/metrics.py bucketed histograms), breach counts
 against the target p99, and the burn rate over the 1% error budget.
 ``--slo-p99``/``--slo-window`` override the CUP3D_FLEET_SLO_* knobs.
+
+Round 17: ``--policy fifo|srb`` picks the continuous-batching reseed
+order, ``--queue-depth``/``--tenant-quota`` set the admission-control
+knobs, and ``--no-continuous`` falls back to the legacy
+generation-drain (the occupancy baseline).
 """
 
 from __future__ import annotations
@@ -37,6 +42,20 @@ def _build_parser(slo: bool) -> argparse.ArgumentParser:
                     help="executable cache cap (CUP3D_FLEET_BUCKETS)")
     ap.add_argument("--workdir", default=None,
                     help="serialization dir (default: fresh tempdir)")
+    ap.add_argument("--policy", choices=("fifo", "srb"), default=None,
+                    help="scheduler policy: fifo (default) or srb = "
+                         "shortest-remaining-budget "
+                         "(CUP3D_FLEET_POLICY)")
+    ap.add_argument("--queue-depth", type=int, default=None,
+                    help="admission backpressure threshold "
+                         "(CUP3D_FLEET_QUEUE_DEPTH)")
+    ap.add_argument("--tenant-quota", type=int, default=None,
+                    help="live jobs per tenant, 0 = unlimited "
+                         "(CUP3D_FLEET_TENANT_QUOTA)")
+    ap.add_argument("--no-continuous", action="store_true",
+                    help="legacy generation-drain instead of "
+                         "continuous batching "
+                         "(CUP3D_FLEET_CONTINUOUS=0)")
     if slo:
         ap.add_argument("--slo-p99", type=float, default=None,
                         help="target p99 end-to-end seconds "
@@ -71,7 +90,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     server = FleetServer(max_lanes=lanes, max_buckets=buckets,
                          workdir=args.workdir,
                          slo_p99_s=getattr(args, "slo_p99", None),
-                         slo_window=getattr(args, "slo_window", None))
+                         slo_window=getattr(args, "slo_window", None),
+                         continuous=(False if args.no_continuous
+                                     else None),
+                         policy=args.policy,
+                         max_queue_depth=args.queue_depth,
+                         tenant_quota=args.tenant_quota)
     for i, sc in enumerate(scenarios):
         server.submit(sc.get("tenant", f"tenant-{i}"), sc)
     summary = server.drain()

@@ -178,6 +178,31 @@ class LaneGuard:
         out = restore_lanes(carry, self.snap, mask, scale)
         return out, int(self.snap_step[lane]), int(self.snap_left[lane])
 
+    def reseed(self, carry, lane: int, nsteps: int) -> None:
+        """A retired lane was respliced with a fresh job (the caller
+        already uploaded the new solo state via fleet/batch.
+        reseed_lane_carry; ``carry`` is the post-upload batched carry).
+        Reseed-vs-rollback contract (VALIDATION.md "Round 17"):
+
+        - the epoch bump drops every in-flight row the previous
+          occupant emitted, exactly like a rollback does;
+        - the retry budget resets — a reseeded lane starts with the
+          full ``max_retries``, not the previous tenant's remainder;
+        - the lane's rows of the rolling snapshot are refreshed to the
+          NEW job's initial state, so a post-reseed rollback restores
+          the new tenant, never a ghost of the old one.  Other lanes'
+          snapshot rows keep their exact bits (lane-wise select)."""
+        self.epochs[lane] += 1
+        self.attempts[lane] = 0
+        self.fail_step[lane] = -1
+        if self.snap is not None:
+            mask = np.zeros(self.B, bool)
+            mask[lane] = True
+            self.snap = _select_lanes(
+                jnp.asarray(mask), carry, self.snap)
+        self.snap_step[lane] = 0
+        self.snap_left[lane] = int(nsteps)
+
     def give_up(self, carry, lane: int, reason: str):
         """Retire a lane that exhausted its retries: freeze its carry
         (left = 0) and bump its epoch so stale rows drop."""

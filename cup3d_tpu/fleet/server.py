@@ -35,14 +35,35 @@ rolling breach window -> burn-rate counters in ``health()``), and
 (3) when tracing is on, emits one ``kind="job"`` aux record plus a
 pid-3 lane-occupancy span into the Perfetto export (obs/trace.py).
 
+Round 17 — continuous batching: with ``CUP3D_FLEET_CONTINUOUS`` on
+(the default) the server is work-conserving at K-boundaries.  A lane
+that retires (done, cancelled, or gave up) is immediately reseeded
+with a compatible queued job — same static signature, so the cached
+executable is reused with zero recompiles; the reseed is a per-lane
+carry upload (fleet/batch.reseed_lane_carry, the same scan-carry
+upload shape as the rollback path) plus a gait-row swap, leaving every
+other lane bitwise untouched.  ``serve(feed)`` accepts ``submit()``
+in-flight with admission control (per-tenant quota + max-queue-depth
+backpressure, surfaced in ``health()["admission"]``), the scheduler
+policy hook picks the reseed order (FIFO default, "srb" =
+shortest-remaining-budget), and lane occupancy (busy-lane-steps /
+total-lane-steps per drain window) lands in the
+``fleet.lane_occupancy`` gauge plus idle spans on the pid-3 Perfetto
+lane tracks.  ``CUP3D_FLEET_CONTINUOUS=0`` keeps the legacy
+generation-drain path bitwise-unchanged.
+
 Env knobs: ``CUP3D_FLEET_LANES`` caps lanes per batch (default 64),
 ``CUP3D_FLEET_BUCKETS`` caps the executable cache (default 8, LRU),
 ``CUP3D_FLEET_MESH=1`` shards the lane axis over visible devices,
 ``CUP3D_FLEET_SLO_P99``/``CUP3D_FLEET_SLO_WINDOW`` set the completion
 SLO (target p99 seconds, rolling job window), and ``CUP3D_SNAP_EVERY``/
-``CUP3D_MAX_RETRIES`` carry their resilience meanings per lane.  Live
-servers surface in the obs /health payload (obs/export.py) through the
-same weakref registry pattern as the flight recorders.
+``CUP3D_MAX_RETRIES`` carry their resilience meanings per lane.
+Round 17 adds ``CUP3D_FLEET_CONTINUOUS`` (default 1),
+``CUP3D_FLEET_POLICY`` (``fifo``/``srb``), ``CUP3D_FLEET_QUEUE_DEPTH``
+(admission backpressure threshold, default 1024) and
+``CUP3D_FLEET_TENANT_QUOTA`` (live jobs per tenant, 0 = unlimited).
+Live servers surface in the obs /health payload (obs/export.py)
+through the same weakref registry pattern as the flight recorders.
 """
 
 from __future__ import annotations
@@ -83,6 +104,22 @@ CANCELLED = "cancelled"
 
 #: lane-count ladder base: fleet batches start amortizing at 2 lanes
 LANE_LADDER_BASE = 2
+
+#: scheduler policies: FIFO (submit order) and shortest-remaining-budget
+#: (smallest nsteps first, cutting p99 under skewed job lengths)
+POLICIES = ("fifo", "srb")
+
+
+class FleetAdmissionError(RuntimeError):
+    """submit() rejected by admission control: the queue is at its
+    backpressure depth, or the tenant is at its live-job quota.  The
+    ``reason`` ("queue-full" / "quota") matches the
+    ``fleet.admission_rejects`` counter label and the backpressure
+    field in ``health()["admission"]``."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(detail)
+        self.reason = reason
 
 
 def _env_int(name: str, default: int) -> int:
@@ -320,6 +357,23 @@ def _static_signature(drv, kind: str) -> tuple:
     return sig
 
 
+def _lane_payload(kind: str, drv, label: str):
+    """One lane's device payload from an initialized driver: the solo
+    carry plus the frozen gait (fish only) — shared by first assembly
+    (stacked into the batched carry) and reseeding (per-lane upload)."""
+    if kind == "fish":
+        ob = drv.sim.obstacles[0]
+        from cup3d_tpu.models.fish.device_midline import freeze_gait
+
+        gait = freeze_gait(ob, drv.sim.time, drv.sim.dtype)
+        if gait is None:
+            raise ValueError(f"{label}: gait not freezable for fleet")
+        return FB.init_fish_carry(drv.sim, ob), gait
+    if kind == "amr_tgv":
+        return FB.init_amr_carry(drv.sim), None
+    return FB.init_tgv_carry(drv.sim), None
+
+
 class FleetBatch:
     """B lanes sharing one compiled executable: the batched carry, the
     host step/budget mirrors, the lane guard, and the QoI stream."""
@@ -348,20 +402,10 @@ class FleetBatch:
         # consumed because jobs[lane] is None there)
         carries, gaits, targets = [], [], []
         for job, drv in zip(jobs, drivers):
-            if kind == "fish":
-                ob = drv.sim.obstacles[0]
-                from cup3d_tpu.models.fish.device_midline import freeze_gait
-
-                gait = freeze_gait(ob, drv.sim.time, drv.sim.dtype)
-                if gait is None:
-                    raise ValueError(
-                        f"{job.job_id}: gait not freezable for fleet")
+            carry, gait = _lane_payload(kind, drv, job.job_id)
+            carries.append(carry)
+            if gait is not None:
                 gaits.append(gait)
-                carries.append(FB.init_fish_carry(drv.sim, ob))
-            elif kind == "amr_tgv":
-                carries.append(FB.init_amr_carry(drv.sim))
-            else:
-                carries.append(FB.init_tgv_carry(drv.sim))
             targets.append(job.nsteps)
         while len(carries) < self.B:
             carries.append(carries[0])
@@ -384,9 +428,13 @@ class FleetBatch:
         self.carry = FB.stack_carries(carries, targets)
         self.gaits = FB.stack_gaits(gaits, s.dtype) if gaits else None
         ob = s.obstacles[0] if kind == "fish" else None
+        #: the static bucket signature — reseed compatibility is THIS
+        #: (the step-budget rung only shapes first assembly; it does
+        #: not enter the executable key, so cross-rung reseeds still
+        #: hit the compiled-advance cache)
+        self.sig = _static_signature(template, kind)
         self.advance = server.executable(
-            _static_signature(template, kind), s, ob, self.B, self.K,
-            kind=kind)
+            self.sig, s, ob, self.B, self.K, kind=kind)
 
         self.step_h = np.zeros(self.B, np.int64)
         self.left_h = np.asarray(targets, np.int64)
@@ -395,6 +443,18 @@ class FleetBatch:
         self.guard.snapshot(self.carry, self.step_h, self.left_h)
         self._since_snap = 0
         self.dispatches = 0
+        # lane-occupancy accounting: busy = budget-gated lane-steps
+        # actually advanced, total = B*K per dispatch (frozen and
+        # padding lanes count against the denominator — that is the
+        # waste continuous batching reclaims)
+        self.busy_steps = 0
+        self.total_steps = 0
+        #: monotonic time each idle lane last went free (padding lanes
+        #: at construction, retired lanes at their terminal mark) —
+        #: the start of the pid-3 idle span the next reseed closes
+        self._lane_free_since: Dict[int, float] = {
+            lane: OT.now() for lane in range(self.B)
+            if self.jobs[lane] is None}
         self.stream = QoIStream(
             self._consume, read_every=1, max_inflight=2,
             name=f"fleet-b{batch_id}")
@@ -449,7 +509,12 @@ class FleetBatch:
         self.left_h -= valid
         self.dispatches += 1
         self._since_snap += 1
+        busy = int(valid.sum())
+        self.busy_steps += busy
+        self.total_steps += self.B * self.K
         M.counter("fleet.dispatches").inc()
+        M.counter("fleet.busy_lane_steps").inc(busy)
+        M.counter("fleet.total_lane_steps").inc(self.B * self.K)
         if self._since_snap >= self.snap_dispatches:
             self.settle()
             self.guard.snapshot(self.carry, self.step_h, self.left_h)
@@ -531,6 +596,10 @@ class FleetBatch:
         job.mark(status)
         M.counter("fleet.lane_retires", reason=reason).inc()
         self.server.update_lane_gauge()
+        # the lane goes idle exactly where the job's occupancy span
+        # ends (the terminal mark), so the idle span a later reseed
+        # emits touches it without overlapping
+        self._lane_free_since[lane] = job.events[-1][1]
         self.server._job_terminal(job, batch=self, lane=lane)
 
     def cancel_lane(self, lane: int) -> None:
@@ -541,6 +610,49 @@ class FleetBatch:
         self.left_h[lane] = 0
         self.guard.epochs[lane] += 1
         self.retire(lane, CANCELLED, "cancelled")
+
+    def free_lanes(self) -> List[int]:
+        """Lanes holding no RUNNING job — padding or retired — i.e.
+        reseed targets for the continuous scheduler.  Callers settle
+        the stream first so pending retirements are visible."""
+        return [lane for lane in range(self.B)
+                if self.jobs[lane] is None
+                or self.jobs[lane].status != RUNNING]
+
+    def reseed_lane(self, lane: int, job: FleetJob, drv) -> None:
+        """Splice a queued job into a freed lane at a K-boundary: a
+        per-lane carry upload + gait-row swap (fleet/batch.py), fresh
+        host mirrors, and a guard reset (epoch bump + full retry budget
+        + snapshot-row refresh, fleet/isolate.py).  Every other lane's
+        carry bits are untouched, and the previous occupant's in-flight
+        rows drop on the epoch bump."""
+        solo, gait = _lane_payload(self.kind, drv, job.job_id)
+        self.carry = FB.reseed_lane_carry(
+            self.carry, lane, solo, job.nsteps)
+        if self.gaits is not None:
+            self.gaits = FB.reseed_lane_gaits(self.gaits, lane, gait)
+        self.step_h[lane] = 0
+        self.left_h[lane] = job.nsteps
+        self.guard.reseed(self.carry, lane, job.nsteps)
+        self.jobs[lane] = job
+        job.lane = lane
+        job.batch = self
+        job.status = RUNNING
+        job.mark("reseeded")
+        job.mark("running")
+        job.rows = np.zeros((job.nsteps, self.row_w), np.float64)
+        self._undispatched.add(lane)
+        M.counter("fleet.reseeds", kind=self.kind).inc()
+        M.counter("fleet.lanes", kind=self.kind).inc()
+        self.server.update_lane_gauge()
+        t_free = self._lane_free_since.pop(lane, None)
+        sink = OT.TRACE
+        if sink.enabled and t_free is not None:
+            t_run = job.event_time("running")
+            if t_run is not None and t_run > t_free:
+                sink.lane_span(
+                    FB.lane_track_id(self.batch_id, lane), "idle",
+                    t_free, t_run - t_free, args={"job_id": "<idle>"})
 
     def lane_state(self, lane: int) -> Dict[str, np.ndarray]:
         """Host copies of one lane's carry leaves (tests, summaries)."""
@@ -578,7 +690,11 @@ class FleetServer:
                  max_retries: Optional[int] = None,
                  workdir: Optional[str] = None,
                  slo_p99_s: Optional[float] = None,
-                 slo_window: Optional[int] = None):
+                 slo_window: Optional[int] = None,
+                 continuous: Optional[bool] = None,
+                 policy: Optional[str] = None,
+                 max_queue_depth: Optional[int] = None,
+                 tenant_quota: Optional[int] = None):
         self.max_lanes = int(
             max_lanes if max_lanes is not None
             else _env_int("CUP3D_FLEET_LANES", 64))
@@ -606,17 +722,63 @@ class FleetServer:
             slo_window if slo_window is not None
             else _env_int("CUP3D_FLEET_SLO_WINDOW", 100)))
         self._slo_windows: Dict[str, deque] = {}
+        # round 17 — continuous-batching knobs + scheduler state
+        self.continuous = bool(
+            continuous if continuous is not None
+            else _env_int("CUP3D_FLEET_CONTINUOUS", 1))
+        self.policy = str(
+            policy if policy is not None
+            else os.environ.get("CUP3D_FLEET_POLICY", "fifo"))
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"unknown scheduler policy {self.policy!r} "
+                f"(expected one of {POLICIES})")
+        self.max_queue_depth = max(1, int(
+            max_queue_depth if max_queue_depth is not None
+            else _env_int("CUP3D_FLEET_QUEUE_DEPTH", 1024)))
+        self.tenant_quota = int(
+            tenant_quota if tenant_quota is not None
+            else _env_int("CUP3D_FLEET_TENANT_QUOTA", 0))
+        self.reseeds = 0
+        self.last_occupancy: Optional[float] = None
+        #: prepared-but-waiting queued jobs: job_id -> (kind, driver,
+        #: sig, bucket key) — a job waiting for a compatible lane is
+        #: not re-inited at every K-boundary
+        self._prepared: Dict[str, tuple] = {}
         _LIVE.append(weakref.ref(self))
 
     # -- tenant lifecycle --------------------------------------------------
 
     def submit(self, tenant: str, spec: dict) -> str:
-        """Validate + enqueue one scenario; returns the job id."""
+        """Validate + enqueue one scenario; returns the job id.
+        Admission control (round 17): a queue at its backpressure
+        depth, or a tenant at its live-job quota, raises
+        :class:`FleetAdmissionError` instead of enqueueing — both
+        rejection counts and the backpressure flag surface in
+        ``health()["admission"]``."""
         kind = str(spec.get("kind", "fish"))
         if kind not in ("fish", "tgv", "amr_tgv"):
             raise ValueError(f"unknown fleet scenario kind {kind!r}")
         if int(spec.get("nsteps", 0)) <= 0:
             raise ValueError("fleet scenario needs nsteps > 0")
+        depth = self.queue_depth()
+        if depth >= self.max_queue_depth:
+            M.counter("fleet.admission_rejects", reason="queue-full").inc()
+            raise FleetAdmissionError(
+                "queue-full",
+                f"queue depth {depth} at backpressure threshold "
+                f"{self.max_queue_depth}")
+        if self.tenant_quota > 0:
+            live = sum(
+                1 for j in self._jobs.values()
+                if j.tenant == str(tenant)
+                and j.status in (QUEUED, RUNNING))
+            if live >= self.tenant_quota:
+                M.counter("fleet.admission_rejects", reason="quota").inc()
+                raise FleetAdmissionError(
+                    "quota",
+                    f"tenant {tenant!r} at live-job quota "
+                    f"{self.tenant_quota}")
         job_id = f"job-{self._next_job:04d}"
         self._next_job += 1
         job = FleetJob(job_id=job_id, tenant=str(tenant), spec=dict(spec),
@@ -637,18 +799,29 @@ class FleetServer:
         if job.status == QUEUED:
             job.status = CANCELLED
             job.mark("cancelled")
+            self._prepared.pop(job_id, None)
             M.counter("fleet.lane_retires", reason="cancelled").inc()
             self._job_terminal(job)
             return True
         if job.status == RUNNING and job.batch is not None:
             job.batch.cancel_lane(job.lane)
-            return True
+            # cancel_lane retires through the batch's guarded retire()
+            # — a lane already swapped or terminal in the batch is a
+            # no-op there, so verify the state actually changed rather
+            # than reporting success unconditionally
+            return job.status == CANCELLED
         return False
 
     def drain(self) -> Dict[str, dict]:
-        """Assemble everything queued and run the dispatch loop (round-
-        robin over batches) until every lane is terminal.  Returns the
-        per-tenant summary."""
+        """Run everything queued to completion and return the per-
+        tenant summary.  Continuous mode (the default) runs the work-
+        conserving serve() loop with admission closed;
+        ``CUP3D_FLEET_CONTINUOUS=0`` keeps the legacy generation-drain
+        (assemble the queue once, run every batch to completion)
+        bitwise-unchanged as the occupancy baseline."""
+        if self.continuous:
+            return self.serve()
+        busy0, total0 = self._occupancy_totals()
         self.assemble()
         while True:
             live = [b for b in self.batches if b.active()]
@@ -658,8 +831,48 @@ class FleetServer:
                 b.tick()
         for b in self.batches:
             b.settle()
+        self._close_occupancy_window(busy0, total0)
         self.update_lane_gauge()
         return self.tenant_summary()
+
+    def serve(self, feed=None) -> Dict[str, dict]:
+        """The continuous-batching dispatch loop: one scheduling pass
+        (reseed freed lanes, assemble what cannot wait) plus one round-
+        robin tick per K-boundary.  ``feed(server, tick)``, when given,
+        is called at each boundary and may ``submit()`` in-flight
+        (admission control applies); it returns False to close
+        admission.  The loop ends when admission is closed and every
+        admitted job is terminal.  Returns the tenant summary."""
+        busy0, total0 = self._occupancy_totals()
+        admitting = feed is not None
+        tick = 0
+        while True:
+            if admitting:
+                # settle first so pending retirements are visible to
+                # the feed's poll()-driven admission decisions; with no
+                # feed there is nothing to decide and the dispatch
+                # pipeline keeps its full in-flight overlap
+                for b in self.batches:
+                    if b.active():
+                        b.settle()
+                admitting = bool(feed(self, tick))
+            self._schedule()
+            live = [b for b in self.batches if b.active()]
+            for b in live:
+                b.tick()
+            tick += 1
+            queued = any(
+                j.status == QUEUED for j in self._jobs.values())
+            if not admitting and not live and not queued:
+                break
+        for b in self.batches:
+            b.settle()
+        self._close_occupancy_window(busy0, total0)
+        self.update_lane_gauge()
+        return self.tenant_summary()
+
+    def queue_depth(self) -> int:
+        return sum(1 for j in self._jobs.values() if j.status == QUEUED)
 
     # -- assembly ----------------------------------------------------------
 
@@ -674,44 +887,48 @@ class FleetServer:
             cap += mult - cap % mult
         return cap
 
-    def assemble(self) -> List[FleetBatch]:
-        """Queued jobs -> bucketed batches.  Buckets key on the static
-        signature plus the ×1.25 step-budget rung; each bucket splits
-        into chunks of <= max_lanes and pads up the lane ladder."""
-        queued = [j for j in self._jobs.values() if j.status == QUEUED]
-        if not queued:
-            return []
+    def _prepare(self, job: FleetJob) -> Optional[tuple]:
+        """Build + init one queued job's lane driver and bucket key,
+        consuming the prepared-job cache when the scheduler already did
+        the work on an earlier pass.  Returns (kind, driver, sig,
+        bucket_key), or None after failing an ineligible job."""
+        prep = self._prepared.pop(job.job_id, None)
+        if prep is not None:
+            return prep
+        kind, cfg = _job_config(job.spec, self.workdir)
+        job.cfg = cfg
+        if kind == "amr_tgv":
+            from cup3d_tpu.sim.amr import AMRSimulation
+
+            drv = _AMRLaneDriver(AMRSimulation(cfg))
+        else:
+            from cup3d_tpu.sim.simulation import Simulation
+
+            drv = Simulation(cfg)
+        drv.init()
+        if not drv._megaloop_eligible():
+            job.status = FAILED
+            job.error = "scenario not scan-eligible"
+            job.mark("failed")
+            M.counter("fleet.lane_retires", reason="ineligible").inc()
+            self._job_terminal(job)
+            return None
+        sig = _static_signature(drv, kind)
+        key = (sig, count_capacity(job.nsteps, base=1))
+        # deterministic bucket-signature label for the SLO
+        # histograms (hash(), being per-process salted, would split
+        # one bucket's series across restarts)
+        job.sig_label = "{}-{}".format(
+            kind,
+            hashlib.blake2s(repr(key).encode()).hexdigest()[:8])
+        job.mark("bucketed")
+        return kind, drv, sig, key
+
+    def _build_batches(self, buckets) -> List[FleetBatch]:
+        """Bucketed (kind, job, driver) groups -> FleetBatches: each
+        bucket splits into chunks of <= max_lanes and pads up the lane
+        ladder."""
         built = []
-        buckets: "OrderedDict[tuple, list]" = OrderedDict()
-        for job in queued:
-            kind, cfg = _job_config(job.spec, self.workdir)
-            job.cfg = cfg
-            if kind == "amr_tgv":
-                from cup3d_tpu.sim.amr import AMRSimulation
-
-                drv = _AMRLaneDriver(AMRSimulation(cfg))
-            else:
-                from cup3d_tpu.sim.simulation import Simulation
-
-                drv = Simulation(cfg)
-            drv.init()
-            if not drv._megaloop_eligible():
-                job.status = FAILED
-                job.error = "scenario not scan-eligible"
-                job.mark("failed")
-                M.counter("fleet.lane_retires", reason="ineligible").inc()
-                self._job_terminal(job)
-                continue
-            sig = _static_signature(drv, kind)
-            key = (sig, count_capacity(job.nsteps, base=1))
-            # deterministic bucket-signature label for the SLO
-            # histograms (hash(), being per-process salted, would split
-            # one bucket's series across restarts)
-            job.sig_label = "{}-{}".format(
-                kind,
-                hashlib.blake2s(repr(key).encode()).hexdigest()[:8])
-            job.mark("bucketed")
-            buckets.setdefault(key, []).append((kind, job, drv))
         for (sig, _rung), members in buckets.items():
             for i in range(0, len(members), self.max_lanes):
                 chunk = members[i:i + self.max_lanes]
@@ -726,8 +943,90 @@ class FleetServer:
                 self._next_batch += 1
                 self.batches.append(b)
                 built.append(b)
+        return built
+
+    def assemble(self) -> List[FleetBatch]:
+        """Queued jobs -> bucketed batches.  Buckets key on the static
+        signature plus the ×1.25 step-budget rung; each bucket splits
+        into chunks of <= max_lanes and pads up the lane ladder."""
+        queued = [j for j in self._jobs.values() if j.status == QUEUED]
+        if not queued:
+            return []
+        buckets: "OrderedDict[tuple, list]" = OrderedDict()
+        for job in queued:
+            prep = self._prepare(job)
+            if prep is None:
+                continue
+            kind, drv, _sig, key = prep
+            buckets.setdefault(key, []).append((kind, job, drv))
+        built = self._build_batches(buckets)
         self.update_lane_gauge()
         return built
+
+    def _schedule(self) -> int:
+        """One K-boundary scheduling pass (continuous batching): settle
+        the live batches so pending retirements are visible, reseed
+        freed lanes with compatible queued jobs (same static signature
+        -> the cached executable is reused with zero recompiles), and
+        assemble fresh batches only for jobs with no compatible live
+        batch to wait on.  Returns the number of lanes reseeded."""
+        queued = [j for j in self._jobs.values() if j.status == QUEUED]
+        if not queued:
+            return 0
+        for b in self.batches:
+            if b.active():
+                b.settle()
+        if self.policy == "srb":
+            # shortest-remaining-budget: stable sort, FIFO within ties
+            queued.sort(key=lambda j: j.nsteps)
+        reseeded = 0
+        leftovers: "OrderedDict[tuple, list]" = OrderedDict()
+        waiting: "OrderedDict[tuple, list]" = OrderedDict()
+        for job in queued:
+            prep = self._prepare(job)
+            if prep is None:
+                continue
+            kind, drv, sig, key = prep
+            placed = blocked = False
+            for b in self.batches:
+                # only LIVE batches are reseed targets: once a batch
+                # fully drains, fresh assembly (which serves the same
+                # executable from the LRU cache) is just as work-
+                # conserving and keeps the generation semantics of an
+                # idle server unchanged
+                if b.kind != kind or b.sig != sig or not b.active():
+                    continue
+                free = b.free_lanes()
+                if free:
+                    b.reseed_lane(free[0], job, drv)
+                    self.reseeds += 1
+                    reseeded += 1
+                    placed = True
+                    break
+                blocked = True
+            if placed:
+                continue
+            if blocked:
+                # a live compatible batch will free a lane at a coming
+                # K-boundary; waiting beats padding out a fresh batch
+                self._prepared[job.job_id] = prep
+                waiting.setdefault(key, []).append((kind, job, drv))
+                continue
+            leftovers.setdefault(key, []).append((kind, job, drv))
+        for key, members in waiting.items():
+            # enough blocked same-rung jobs to FILL a batch beats
+            # waiting: zero padding lanes, so assembling now is a
+            # strict occupancy win over a reseed slot later
+            if (len(members) > 1
+                    and self.lane_capacity(len(members)) == len(members)):
+                for _, job, _ in members:
+                    self._prepared.pop(job.job_id, None)
+                leftovers.setdefault(key, []).extend(members)
+        if leftovers:
+            self._build_batches(leftovers)
+        if reseeded or leftovers:
+            self.update_lane_gauge()
+        return reseeded
 
     def executable(self, sig: tuple, s, ob, cap: int, K: int,
                    kind: Optional[str] = None):
@@ -748,6 +1047,27 @@ class FleetServer:
         return fn
 
     # -- observability -----------------------------------------------------
+
+    def _occupancy_totals(self) -> Tuple[int, int]:
+        return (sum(b.busy_steps for b in self.batches),
+                sum(b.total_steps for b in self.batches))
+
+    def _close_occupancy_window(self, busy0: int,
+                                total0: int) -> Optional[float]:
+        """Fold one drain/serve window into the ``fleet.lane_occupancy``
+        gauge: busy-lane-steps / total-lane-steps over the window's
+        dispatches.  Frozen and padding lanes count against the
+        denominator — that is exactly the waste continuous batching
+        reclaims, so the gauge is the bench gate's metric
+        (bench.py fleet_skew, gates.fleet_occupancy)."""
+        busy, total = self._occupancy_totals()
+        dbusy, dtotal = busy - busy0, total - total0
+        if dtotal <= 0:
+            return None
+        occ = dbusy / dtotal
+        self.last_occupancy = occ
+        M.gauge("fleet.lane_occupancy").set(occ)
+        return occ
 
     def _job_terminal(self, job: FleetJob, batch: Optional[FleetBatch]
                       = None, lane: Optional[int] = None) -> None:
@@ -872,6 +1192,7 @@ class FleetServer:
 
     def health(self) -> dict:
         """Fleet state for the obs /health endpoint."""
+        depth = self.queue_depth()
         return {
             "jobs": self.jobs_by_status(),
             "lanes_active": int(
@@ -881,6 +1202,18 @@ class FleetServer:
             "rollbacks": int(sum(b.guard.rollbacks for b in self.batches)),
             "executables": len(self._execs),
             "slo": self.slo_status(),
+            "admission": {
+                "queue_depth": depth,
+                "max_queue_depth": self.max_queue_depth,
+                "backpressure": depth >= self.max_queue_depth,
+                "tenant_quota": self.tenant_quota,
+            },
+            "scheduler": {
+                "continuous": self.continuous,
+                "policy": self.policy,
+                "reseeds": int(self.reseeds),
+                "lane_occupancy": self.last_occupancy,
+            },
             "knobs": {
                 "max_lanes": self.max_lanes,
                 "max_buckets": self.max_buckets,

@@ -26,7 +26,11 @@ is a tested contract, not a formatting accident.
 ``/health`` reports what a supervisor needs before scraping history:
 per-flight-recorder arm state + last-known-good step (the weakref
 registry in ``obs/flight.py``), recovery/flight counters, trace sink
-and capture-window state.
+and capture-window state.  Live fleet servers report through their
+own ``health()`` — round 17 adds the ``"admission"`` block (queue
+depth, threshold, backpressure flag, tenant quota: the supervisor's
+shed-load signal) and the ``"scheduler"`` block (continuous flag,
+policy, reseed count, last window's lane occupancy).
 """
 
 from __future__ import annotations

@@ -90,6 +90,16 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("detail", "fleet_job_p99_s")),
         higher_is_better=False,
     ),
+    # round 17 (continuous batching): lane occupancy of the seeded
+    # heavy-tailed fleet_skew mix (bench.py) — busy-lane-steps over
+    # total-lane-steps for the continuous serve window; a DROP means
+    # the scheduler stopped reseeding freed lanes, so higher is better
+    MetricSpec(
+        "fleet_occupancy",
+        (("fleet_skew", "fleet_occupancy"),
+         ("detail", "fleet_occupancy")),
+        higher_is_better=True,
+    ),
 )
 
 

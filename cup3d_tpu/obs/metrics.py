@@ -43,6 +43,13 @@ full catalog): ``faults.injected{site=…}`` per injected firing;
 ``.ckpt_dropped`` and ``dump.write_dropped`` from the hardened write
 paths; ``flight.recovery_events`` per recorded rollback event.
 
+Round 17 adds the continuous-batching families (README "Continuous
+batching"): ``fleet.reseeds{kind=…}`` per work-conserving lane
+reseed, ``fleet.admission_rejects{reason=queue-full|quota}`` per
+rejected submit, ``fleet.busy_lane_steps`` / ``fleet.total_lane_steps``
+per dispatch window, and the ``fleet.lane_occupancy`` gauge (their
+ratio over the last drain/serve window).
+
 This module deliberately imports neither jax nor numpy: it must stay
 importable (and cheap) from anywhere, including the analysis layer.
 """

@@ -101,9 +101,12 @@ JOB_REQUIRED = {"schema": int, "step": int, "job_id": str, "tenant": str,
 
 #: the job-lifecycle span catalog (README "Serving observability"):
 #: every event name a FleetJob timeline may carry, in nominal order —
-#: rollback/retire interleave per lane fault, terminal status last
-JOB_EVENTS = ("submitted", "queued", "bucketed", "running", "dispatched",
-              "fanout", "rollback", "retire",
+#: rollback/retire interleave per lane fault, terminal status last.
+#: "reseeded" marks a job spliced into a freed lane of a live batch at
+#: a K-boundary (continuous batching, round 17) instead of waiting for
+#: a fresh assembly; it follows "bucketed" on that path.
+JOB_EVENTS = ("submitted", "queued", "bucketed", "reseeded", "running",
+              "dispatched", "fanout", "rollback", "retire",
               "done", "failed", "cancelled")
 
 #: Perfetto pid of the per-lane job-occupancy tracks (pid 1 = host

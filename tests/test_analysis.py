@@ -583,6 +583,81 @@ def test_jx013_host_only_lane_loops_are_clean():
                    for v in _failing(other_axis, FLEET))
 
 
+def test_jx015_batch_reassembly_fires_suppresses_and_scopes():
+    """Per-tick host reassembly of the full lane-stacked batch in
+    fleet/ (round 17): a reseed must replace ONE lane via the jitted
+    .at[lane].set upload, not restack the whole B-lane pytree."""
+    FLEET = "cup3d_tpu/fleet/fixture.py"
+    src = (
+        "import jax.numpy as jnp\n"
+        "class Batch:\n"
+        "    def reseed_lane(self, lane, solo):\n"
+        "        self.u = jnp.stack([c['u'] for c in self.parts])\n"
+    )
+    vs = _failing(src, FLEET)
+    assert _rules(vs) == {"JX015"}
+    assert ".at[lane].set" in vs[0].message
+    # the repo's own assembly helpers stack by construction — any
+    # dotted prefix fires inside a tick/reseed/dispatch function
+    helper = (
+        "from cup3d_tpu.fleet import batch as FB\n"
+        "class Batch:\n"
+        "    def tick(self):\n"
+        "        self.carry = FB.stack_carries(self.solos)\n"
+    )
+    assert _rules(_failing(helper, FLEET)) == {"JX015"}
+    # np.concatenate in a dispatch path is the same hazard
+    cat = (
+        "import numpy as np\n"
+        "def dispatch_all(rows):\n"
+        "    return np.concatenate(rows)\n"
+    )
+    assert _rules(_failing(cat, FLEET)) == {"JX015"}
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "        self.u = jnp.stack",
+        "        # jax-lint: allow(JX015, one-shot debug snapshot, not\n"
+        "        # the reseed upload path)\n"
+        "        self.u = jnp.stack",
+    )
+    all_vs = L.lint_source(ok, FLEET)
+    assert not L.failing(all_vs)
+    assert any(v.rule == "JX015" and "debug snapshot" in
+               (v.suppression_reason or "") for v in all_vs)
+    # scoped to fleet/: the same code elsewhere is other rules' business
+    assert not any(v.rule == "JX015" for v in _failing(src, HOT))
+
+
+def test_jx015_construction_and_upload_paths_are_clean():
+    """Batch CONSTRUCTION stacks legitimately (assemble/__init__ don't
+    match the per-tick name gate), the jitted per-lane upload is the
+    sanctioned path, and bare non-array stack() calls never fire."""
+    FLEET = "cup3d_tpu/fleet/fixture.py"
+    build = (
+        "import jax.numpy as jnp\n"
+        "from cup3d_tpu.fleet import batch as FB\n"
+        "class Batch:\n"
+        "    def __init__(self, solos):\n"
+        "        self.carry = FB.stack_carries(solos)\n"
+        "    def assemble(self, parts):\n"
+        "        return jnp.stack(parts)\n"
+    )
+    assert not any(v.rule == "JX015" for v in _failing(build, FLEET))
+    upload = (
+        "class Batch:\n"
+        "    def reseed_lane(self, lane, solo):\n"
+        "        self.carry = {k: self.carry[k].at[lane].set(solo[k])\n"
+        "                      for k in solo}\n"
+    )
+    assert not any(v.rule == "JX015" for v in _failing(upload, FLEET))
+    # a bare/unknown-root stack() is not an array op
+    bare = (
+        "def tick(frames, stack):\n"
+        "    return stack(frames)\n"
+    )
+    assert not any(v.rule == "JX015" for v in _failing(bare, FLEET))
+
+
 def test_jx014_wallclock_duration_fires_and_suppresses():
     """Wall-clock subtraction used as a duration (round 16): NTP slews
     and steps time.time(), so a latency computed from it can go

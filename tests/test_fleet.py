@@ -14,6 +14,12 @@
   per-tenant summary and obs /health fleet state are coherent.
 - Byte-stable fan-out: two identical drains produce bitwise-identical
   per-tenant QoI buffers.
+- Continuous batching (round 17): work-conserving lane reseeding at
+  K-boundaries — reseeds are bitwise non-interfering and compile-free,
+  serve() admits submissions in-flight under quota/backpressure
+  control, a failed lane reseeds with a fresh retry budget, and the
+  CUP3D_FLEET_CONTINUOUS=0 generation-drain baseline stays
+  bitwise-unchanged.
 """
 
 import json
@@ -329,3 +335,195 @@ def test_fleet_cli_and_health_payload(tmp_path, capsys):
     assert any(h["jobs"].get(DONE, 0) >= 1 and h["batches"] >= 1
                for h in payload["fleet"])
     assert any(k.startswith("fleet.") for k in payload["recovery_counters"])
+
+
+# -- round 17: continuous batching ------------------------------------------
+
+
+def test_legacy_drain_matches_continuous_no_arrivals(tmp_path):
+    """With nothing submitted mid-flight the continuous serve loop is
+    observationally identical to the legacy generation-drain: same
+    statuses, byte-identical per-tenant QoI, zero reseeds — the
+    CUP3D_FLEET_CONTINUOUS=0 baseline stays bitwise-unchanged."""
+    specs = [_tgv_spec(cfl=0.3), _tgv_spec(cfl=0.25),
+             _tgv_spec(cfl=0.28, nsteps=16)]
+    legacy, lid = _drain(tmp_path / "legacy", specs, continuous=False)
+    cont, cid = _drain(tmp_path / "cont", specs, continuous=True)
+    assert cont.reseeds == 0
+    assert legacy.jobs_by_status() == cont.jobs_by_status() == {DONE: 3}
+    for j1, j2 in zip(lid, cid):
+        assert legacy._jobs[j1].qoi_bytes() == cont._jobs[j2].qoi_bytes()
+
+
+def test_reseed_bitwise_non_interference(tmp_path):
+    """Reseeding a freed lane leaves every OTHER lane leaf-for-leaf
+    identical to a serve that never reseeds — the round-14 isolation
+    contract extended to reseeding — and the spliced-in tenant
+    completes on the reused lane."""
+    # one bucket (nsteps 8 and 9 share the ×1.25 step rung): lane 0
+    # retires after a single dispatch while lanes 1-2 still run
+    specs = [_tgv_spec(nsteps=8, cfl=0.3), _tgv_spec(nsteps=9, cfl=0.25),
+             _tgv_spec(nsteps=9, cfl=0.28)]
+    ref, rid = _drain(tmp_path / "ref", specs)
+
+    srv = FleetServer(workdir=str(tmp_path / "srv"))
+    ids = [srv.submit(f"tenant-{i}", sp) for i, sp in enumerate(specs)]
+    late = {}
+
+    def feed(server, tick):
+        if "id" not in late and server.poll(ids[0])["status"] == DONE:
+            late["id"] = server.submit(
+                "late", _tgv_spec(nsteps=8, cfl=0.2))
+        return "id" not in late
+
+    srv.serve(feed)
+    assert srv.reseeds == 1
+    assert srv.poll(late["id"])["status"] == DONE
+    assert srv._jobs[late["id"]].lane == srv._jobs[ids[0]].lane == 0
+    for jid, ref_jid in zip(ids[1:], rid[1:]):
+        assert srv.poll(jid)["status"] == DONE
+        mine, theirs = srv.lane_state(jid), ref.lane_state(ref_jid)
+        assert sorted(mine) == sorted(theirs)
+        for k in mine:
+            np.testing.assert_array_equal(mine[k], theirs[k])
+        assert (srv._jobs[jid].qoi_bytes()
+                == ref._jobs[ref_jid].qoi_bytes())
+
+
+def test_submit_during_serve_admission(tmp_path):
+    """serve() accepts submissions in-flight: late jobs land in freed
+    lanes of the live batch (cross-rung, so no new batch and no new
+    executable) and the occupancy window closes into the gauge."""
+    srv = FleetServer(workdir=str(tmp_path))
+    srv.submit("t0", _tgv_spec(nsteps=8))
+    srv.submit("t0", _tgv_spec(nsteps=32))
+    stream = [_tgv_spec(nsteps=8), _tgv_spec(nsteps=8)]
+
+    def feed(server, tick):
+        if stream and server.queue_depth() == 0:
+            server.submit("late", stream.pop(0))
+        return bool(stream)
+
+    s0 = M.snapshot()
+    srv.serve(feed)
+    d = M.delta(s0)
+    assert srv.jobs_by_status() == {DONE: 4}
+    assert srv.reseeds == 2
+    assert d["fleet.reseeds{kind=tgv}"] == 2
+    # rungs differ but (sig, cap, K) match: one executable, one build
+    assert d["fleet.executable_builds"] == 1
+    health = srv.health()
+    assert health["scheduler"]["reseeds"] == 2
+    assert health["scheduler"]["continuous"] is True
+    assert health["admission"]["backpressure"] is False
+    assert 0.0 < srv.last_occupancy <= 1.0
+    assert d["fleet.busy_lane_steps"] <= d["fleet.total_lane_steps"]
+
+
+def test_reseed_zero_recompile(tmp_path):
+    """Reseeds are compile-free: a serve window with three reseeds
+    compiles the vmapped advance exactly once (the single bucket) and
+    the per-lane upload path traces once — steady-state reseeds touch
+    neither."""
+    from cup3d_tpu.analysis import runtime as R
+
+    srv = FleetServer(workdir=str(tmp_path))
+    srv.submit("t", _tgv_spec(nsteps=8))
+    srv.submit("t", _tgv_spec(nsteps=32))
+    stream = [_tgv_spec(nsteps=8, cfl=0.3 - 0.01 * i) for i in range(3)]
+
+    def feed(server, tick):
+        if stream and server.queue_depth() == 0:
+            server.submit("late", stream.pop(0))
+        return bool(stream)
+
+    s0 = M.snapshot()
+    with R.RecompileCounter() as rc:
+        srv.serve(feed)
+    d = M.delta(s0)
+    assert srv.jobs_by_status() == {DONE: 5}
+    assert srv.reseeds == 3
+    assert rc.compiles.get("advance", 0) == 1
+    assert d["fleet.executable_builds"] == 1
+
+
+def test_lane_nan_fault_then_reseed_same_lane(tmp_path):
+    """A lane whose tenant exhausts its retry budget retires FAILED,
+    then is reseeded with fresh work on the SAME lane: the new tenant
+    starts with a full retry budget and completes cleanly."""
+    srv = FleetServer(workdir=str(tmp_path), max_retries=0)
+    # one bucket (8 and 9 share the step rung): the batch stays live
+    # on lane 1 while lane 0 fails and is reseeded
+    doomed = srv.submit("t", _tgv_spec(nsteps=8, cfl=0.3))
+    other = srv.submit("t", _tgv_spec(nsteps=9, cfl=0.25))
+    faults.arm("fleet.lane_nan", 0, 1)
+    late = {}
+
+    def feed(server, tick):
+        if "id" not in late and server.poll(doomed)["status"] == FAILED:
+            late["id"] = server.submit(
+                "late", _tgv_spec(nsteps=8, cfl=0.2))
+        return "id" not in late
+
+    s0 = M.snapshot()
+    srv.serve(feed)
+    d = M.delta(s0)
+    assert srv.poll(doomed)["status"] == FAILED
+    assert srv.poll(other)["status"] == DONE
+    assert srv.poll(late["id"])["status"] == DONE
+    assert d["fleet.lane_giveups{reason=nan-velocity}"] == 1
+    job = srv._jobs[late["id"]]
+    assert job.lane == srv._jobs[doomed].lane == 0
+    assert job.batch is srv._jobs[doomed].batch
+    assert job.steps_done == job.nsteps
+    # fresh retry budget on the reseeded lane
+    assert job.batch.guard.attempts[0] == 0
+    assert job.batch.guard.fail_step[0] == -1
+
+
+def test_admission_quota_and_backpressure(tmp_path):
+    """Per-tenant quota and max-queue-depth backpressure reject at
+    submit() with typed reasons, count into fleet.admission_rejects,
+    and surface in health()["admission"]."""
+    from cup3d_tpu.fleet.server import FleetAdmissionError
+
+    srv = FleetServer(workdir=str(tmp_path), tenant_quota=2)
+    srv.submit("a", _tgv_spec())
+    srv.submit("a", _tgv_spec())
+    s0 = M.snapshot()
+    with pytest.raises(FleetAdmissionError) as exc:
+        srv.submit("a", _tgv_spec())
+    assert exc.value.reason == "quota"
+    srv.submit("b", _tgv_spec())  # other tenants unaffected
+    assert M.delta(s0)["fleet.admission_rejects{reason=quota}"] == 1
+
+    srv2 = FleetServer(workdir=str(tmp_path), max_queue_depth=2)
+    srv2.submit("a", _tgv_spec())
+    srv2.submit("b", _tgv_spec())
+    assert srv2.health()["admission"]["backpressure"] is True
+    s0 = M.snapshot()
+    with pytest.raises(FleetAdmissionError) as exc:
+        srv2.submit("c", _tgv_spec())
+    assert exc.value.reason == "queue-full"
+    assert M.delta(s0)["fleet.admission_rejects{reason=queue-full}"] == 1
+
+
+def test_cancel_running_verifies_lane_state(tmp_path):
+    """cancel() on a RUNNING job reports whether cancel_lane actually
+    changed lane state: a lane that no longer holds the job returns
+    False instead of the old unconditional True."""
+    srv = FleetServer(workdir=str(tmp_path), continuous=False)
+    jid = srv.submit("t", _tgv_spec(nsteps=64))
+    srv.assemble()
+    assert srv.poll(jid)["status"] == "running"
+    assert srv.cancel(jid) is True
+    assert srv.poll(jid)["status"] == CANCELLED
+    assert srv.cancel(jid) is False
+
+    # a stale handle: the batch lane no longer holds the job (as after
+    # a swap), so the guarded retire is a no-op and cancel must say so
+    jid2 = srv.submit("t", _tgv_spec(nsteps=64))
+    srv.assemble()
+    job2 = srv._jobs[jid2]
+    job2.batch.jobs[job2.lane] = None
+    assert srv.cancel(jid2) is False

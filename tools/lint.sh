@@ -1,9 +1,9 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX014
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX015
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
 # swallowed-exception, JX011 bf16-reduction-accumulator, JX012
-# profiler-outside-obs, JX013 per-lane-loop and JX014
-# wall-clock-duration rules)
+# profiler-outside-obs, JX013 per-lane-loop, JX014
+# wall-clock-duration and JX015 per-tick-batch-reassembly rules)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
 # + the obs trace schema selftest (tools/trace_check.py), the
 # device-attribution parser selftest (obs/profile.py), the bench-
@@ -59,6 +59,13 @@ python -m cup3d_tpu.analysis --rules JX013 cup3d_tpu/fleet -q
 # the monotonic clock (obs.trace.now())
 echo "== python -m cup3d_tpu.analysis --rules JX014 $PATHS"
 python -m cup3d_tpu.analysis --rules JX014 $PATHS -q
+
+# the per-tick-batch-reassembly rule on its own line (round 17): a
+# tick/reseed/dispatch path in fleet/ restacking the full lane axis
+# fails CI identifiably — a reseed replaces ONE lane via the jitted
+# .at[lane].set upload (fleet/batch.py reseed_lane_carry)
+echo "== python -m cup3d_tpu.analysis --rules JX015 cup3d_tpu/fleet"
+python -m cup3d_tpu.analysis --rules JX015 cup3d_tpu/fleet -q
 
 # fused-kernel smoke (round 12): the interpret-mode selftest exercises
 # every Pallas stage of the fused BiCGSTAB driver without a TPU

@@ -5,8 +5,8 @@ Reads the append-only JSONL store ``bench.py`` writes after every run
 (``cup3d_tpu.obs.history``) and, per tracked metric (the
 ``DEFAULT_SPECS`` set: ``cells_per_s``, ``bicgstab_iter_device_ms``,
 ``wall_per_step_p95_s``, ``fleet_cells_per_s``, ``amr_cells_per_s``,
-``amr_bicgstab_iter_device_ms``, ``fleet_job_p99_s``), compares the
-newest value against the
+``amr_bicgstab_iter_device_ms``, ``fleet_job_p99_s``,
+``fleet_occupancy``), compares the newest value against the
 median of the previous N — the BENCH_r0x snapshots as a
 machine-checkable time series.
 
@@ -83,7 +83,10 @@ def selftest() -> None:
                 },
                 # round 16: p99 job latency from the fleet_slo config —
                 # tail latency RISES when the run slows down
-                "fleet_slo": {"fleet_job_p99_s": 2.0 / amr_scale}}
+                "fleet_slo": {"fleet_job_p99_s": 2.0 / amr_scale},
+                # round 17: lane occupancy of the continuous-batching
+                # fleet_skew config — DROPS when reseeding degrades
+                "fleet_skew": {"fleet_occupancy": 0.8 * amr_scale}}
 
     with tempfile.TemporaryDirectory() as td:
         store = obs_history.HistoryStore(os.path.join(td, "hist.jsonl"))
@@ -106,7 +109,7 @@ def selftest() -> None:
         for name in ("cells_per_s", "bicgstab_iter_device_ms",
                      "wall_per_step_p95_s", "fleet_cells_per_s",
                      "amr_cells_per_s", "amr_bicgstab_iter_device_ms",
-                     "fleet_job_p99_s"):
+                     "fleet_job_p99_s", "fleet_occupancy"):
             assert by[name]["regressed"], (name, by[name])
         # a malformed line is skipped, not fatal
         with open(store.path, "a") as f:
