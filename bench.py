@@ -1508,11 +1508,109 @@ def bench_fleet_skew():
     }
 
 
+def bench_mesh2d():
+    """Round-18 scale-out config: the TGV K-step megaloop timed twice
+    on the SAME grid — solo (single-device scan body) and sharded
+    across the ``(lanes=1, x=D)`` slab mesh (``CUP3D_MESH_X=D``, ring
+    halo exchange on the x axis, parallel/topology.py).  The headline
+    is ``mesh_cells_per_s`` — sharded steady-state step throughput —
+    and the gate is scaling efficiency ``(solo_wall / sharded_wall) /
+    D``.  The gate is asserted only on real multi-chip backends:
+    ``--xla_force_host_platform_device_count`` devices timeshare the
+    same host cores, so CPU "scaling" measures sharding overhead, not
+    scaling — the efficiency is still recorded for trend watching."""
+    import tempfile
+
+    import jax
+
+    from cup3d_tpu.config import SimulationConfig
+    from cup3d_tpu.sim.simulation import Simulation
+
+    ndev = len(jax.devices())
+    want = int(os.environ.get("CUP3D_BENCH_MESH_X", str(min(ndev, 4))))
+    K = 8
+    bs = 16
+    bpd = max(2, _scaled(64) // bs)
+    n = bpd * bs
+
+    def cfg():
+        return SimulationConfig(
+            bpdx=bpd, bpdy=bpd, bpdz=bpd, block_size=bs, levelMax=1,
+            levelStart=0, extent=float(2 * np.pi), CFL=0.3, nu=0.02,
+            nsteps=10**9, tend=0.0, rampup=0, initCond="taylorGreen",
+            pipelined=True, verbose=False, freqDiagnostics=0, scan_k=K,
+            path4serialization=tempfile.mkdtemp(prefix="cup3d-benchmesh-"),
+        )
+
+    def leg(mesh_x, tag):
+        prev = os.environ.pop("CUP3D_MESH_X", None)
+        if mesh_x:
+            os.environ["CUP3D_MESH_X"] = str(mesh_x)
+        try:
+            sim = Simulation(cfg())
+            sim.init()
+            if not sim._scan_ready():
+                raise RuntimeError("megaloop not eligible")
+            sharded = sim._scan_mesh is not None
+            for _ in range(2):  # compile + one warm dispatch
+                sim.advance_megaloop()
+            jax.block_until_ready(sim.sim.state["vel"])
+            iters = 4
+            with _maybe_trace(f"mesh2d_{tag}"):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    sim.advance_megaloop()
+                    # scalar host read forces execution (see
+                    # bench_tgv_iterative)
+                    float(sim.sim.state["vel"][0, 0, 0, 0])
+                wall = (time.perf_counter() - t0) / (iters * K)
+            return wall, sharded
+        finally:
+            os.environ.pop("CUP3D_MESH_X", None)
+            if prev is not None:
+                os.environ["CUP3D_MESH_X"] = prev
+
+    wall_solo, _ = leg(0, "solo")
+    out = {
+        "cells_per_s": n**3 / wall_solo,
+        "wall_per_step_solo_s": round(wall_solo, 5),
+        "n": n,
+        "scan_k": K,
+        "devices": ndev,
+        "mesh_x": want,
+    }
+    if want < 2 or n % want != 0:
+        out["mesh_skipped"] = (
+            f"need >=2 devices with n % D == 0 (D={want}, n={n}, "
+            f"{ndev} devices)")
+        out["mesh_cells_per_s"] = 0.0
+        return out
+    wall_shd, sharded = leg(want, "sharded")
+    speedup = wall_solo / max(wall_shd, 1e-12)
+    eff = speedup / want
+    on_tpu = jax.default_backend() == "tpu"
+    out.update({
+        # the tracked headline: sharded steady-state throughput
+        "mesh_cells_per_s": n**3 / wall_shd,
+        "wall_per_step_sharded_s": round(wall_shd, 5),
+        "mesh_active": bool(sharded),  # False = loud solo fallback ran
+        "mesh_speedup": round(speedup, 3),
+        "mesh_efficiency": round(eff, 3),
+        "mesh_efficiency_gate": 0.6,
+        "mesh_efficiency_gate_ok": (
+            bool(sharded and eff >= 0.6) if on_tpu
+            else "skipped (no TPU: virtual host devices timeshare the "
+                 "same cores, efficiency is overhead not scaling)"
+        ),
+    })
+    return out
+
+
 def main():
     which = os.environ.get("CUP3D_BENCH_CONFIG", "all")
     if which not in ("fish", "fish256", "tgv", "spectral", "amr",
                      "channel", "amr_tgv", "fleet", "fleet_slo",
-                     "fleet_skew", "all"):
+                     "fleet_skew", "mesh2d", "all"):
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "vs_baseline": 0,
                           "error": f"unknown CUP3D_BENCH_CONFIG {which!r}"}))
@@ -1551,12 +1649,13 @@ def main():
         ("fleet32", bench_fleet32),
         ("fleet_slo", bench_fleet_slo),
         ("fleet_skew", bench_fleet_skew),
+        ("mesh2d", bench_mesh2d),
     ):
         sel = {"fish256": None, "tgv_iterative": "tgv",
                "spectral": "spectral", "two_fish_amr": "amr",
                "channel": "channel", "amr_tgv": "amr_tgv",
                "fleet32": "fleet", "fleet_slo": "fleet_slo",
-               "fleet_skew": "fleet_skew"}[key]
+               "fleet_skew": "fleet_skew", "mesh2d": "mesh2d"}[key]
         if which != "all" and which != sel:
             continue
         try:

@@ -84,6 +84,15 @@ Rule summary (full rationale in ``analysis/rules.py``):
          upload path (``fleet/batch.py reseed_lane_carry``).  Batch
          CONSTRUCTION (assemble/__init__) still stacks legitimately:
          the rule keys on the per-tick function names.
+- JX016  full-array materialization in a sharded step path:
+         ``jax.device_get``/``np.asarray``/``np.array`` (or a single-
+         argument ``jax.device_put``) inside a step/advance/dispatch/
+         megaloop function in ``cup3d_tpu/{sim,fleet,parallel}/``
+         gathers a (possibly mesh-sharded) array whole onto one host
+         or device — the scale-out ceiling the round-18 2-D mesh
+         removes.  Slice shard-locally under shard_map, place with an
+         explicit ``device_put(x, sharding)``, and stage host reads
+         through the designed sync points (sanctioned_transfer).
 """
 
 from __future__ import annotations
@@ -190,6 +199,28 @@ JX015_FUNC_RE = re.compile(r"(^|_)(ticks?|reseeds?|dispatch(es)?)",
 #: repo's own assembly helpers, which stack by construction
 JX015_STACKERS = frozenset({"stack", "concatenate", "vstack", "hstack"})
 JX015_ASSEMBLY_HELPERS = frozenset({"stack_carries", "stack_gaits"})
+
+#: JX016 scope: the modules hosting mesh-sharded steady-state paths
+#: (solo megaloop slabs in sim/, the lane-sharded fleet advance in
+#: fleet/, the forest/topology layer in parallel/)
+JX016_MODULE_RE = re.compile(r"cup3d_tpu/(sim|fleet|parallel)/")
+
+#: functions on the sharded fast path: the step bodies and their
+#: drivers' per-boundary seams
+JX016_FUNC_RE = re.compile(r"step|advance|dispatch|megaloop",
+                           re.IGNORECASE)
+
+#: builder factories (make_*/build_*/bind_*) run ONCE per topology to
+#: stage trace-time constants — not the steady-state path.  Their inner
+#: step closures are visited under their own names and stay covered.
+JX016_BUILDER_RE = re.compile(r"^(make_|build_|bind_|_build_)")
+
+#: host-materializing callables JX016 watches: full device->host pulls
+#: (device_get / np.asarray / np.array on a device value) plus the
+#: single-argument device_put, which re-places the WHOLE array onto
+#: jax's default device (a cross-shard gather when the input was
+#: sharded); device_put WITH an explicit sharding argument stays legal
+JX016_HOST_PULLS = frozenset({"device_get", "asarray", "array"})
 
 
 def _is_host_metadata(expr: ast.AST) -> bool:
@@ -458,6 +489,8 @@ class FileLint:
             if JX013_MODULE_RE.search(self.path):
                 self._check_lane_device_loop(func, qualname)  # JX013
                 self._check_batch_reassembly(func, qualname)  # JX015
+            if JX016_MODULE_RE.search(self.path):
+                self._check_sharded_materialization(func, qualname)  # JX016
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         self._check_wallclock_duration(self.tree, "<module>")  # JX014
@@ -1236,6 +1269,65 @@ class FileLint:
                 "inside a per-tick path; replace one lane via the "
                 "jitted `.at[lane].set` upload instead "
                 "(fleet/batch.py reseed_lane_carry/reseed_lane_gaits)",
+            )
+
+    # -- JX016 -------------------------------------------------------------
+
+    def _check_sharded_materialization(
+        self, func: ast.AST, qualname: str
+    ) -> None:
+        """Full-array materialization inside a sharded step path
+        (JX016, sim|fleet|parallel only).  Fires inside functions named
+        like the steady-state seam (JX016_FUNC_RE: step/advance/
+        dispatch/megaloop) on ``jax.device_get``, ``np.asarray`` /
+        ``np.array``, and the single-argument form of
+        ``jax.device_put`` — each of which gathers a (possibly mesh-
+        sharded) array whole onto one host or one device.
+        ``device_put(x, sharding)`` with an explicit placement is the
+        sanctioned way to move data and never matches; ``jnp.asarray``
+        stays a device-side cast and is JX004/JX010's business.  Calls
+        inside a ``with sanctioned_transfer(...)`` block are exempt —
+        that context manager IS the designed-sync-point marker the
+        runtime transfer guard audits (analysis/runtime.py)."""
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if not JX016_FUNC_RE.search(func.name):
+            return
+        if JX016_BUILDER_RE.match(func.name):
+            return
+        sanctioned: Set[int] = set()
+        for node in _walk_shallow(func):
+            if isinstance(node, ast.With) and any(
+                isinstance(it.context_expr, ast.Call)
+                and _call_name(it.context_expr).rsplit(".", 1)[-1]
+                == "sanctioned_transfer"
+                for it in node.items
+            ):
+                for sub in ast.walk(node):
+                    sanctioned.add(id(sub))
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call) or id(node) in sanctioned:
+                continue
+            name = _call_name(node)
+            leaf = name.rsplit(".", 1)[-1]
+            root = name.split(".", 1)[0].lstrip("_")
+            if leaf == "device_get" and root in ("jax",):
+                what = "pulls the full array to the host"
+            elif (leaf in ("asarray", "array")
+                  and root in ("np", "numpy")):
+                what = "materializes the full array host-side"
+            elif (leaf == "device_put" and root in ("jax",)
+                    and len(node.args) == 1 and not node.keywords):
+                what = ("re-places the full array onto the default "
+                        "device (no explicit sharding)")
+            else:
+                continue
+            self._emit(
+                "JX016", node, qualname,
+                f"`{name}()` {what} inside a sharded step path — a "
+                "cross-shard gather under the 2-D mesh; slice shard-"
+                "locally under shard_map or place with an explicit "
+                "`device_put(x, sharding)`",
             )
 
     # -- JX009 -------------------------------------------------------------

@@ -206,6 +206,24 @@ RULES: Dict[str, Rule] = {
             "legitimately — the rule keys on per-tick function names.",
         ),
         Rule(
+            "JX016",
+            "full-array materialization in a sharded step path",
+            "jax.device_get()/np.asarray()/np.array() — or a single-"
+            "argument jax.device_put() — on a device value inside a "
+            "step/advance/dispatch/megaloop function in cup3d_tpu/"
+            "{sim,fleet,parallel}/ gathers the FULL array to one host "
+            "or one device.  Under the round-18 2-D (lanes, x) mesh "
+            "those arrays are shard-resident: the gather serializes "
+            "every shard through a single host link (the exact "
+            "scale-out ceiling the mesh removes), doubles peak memory "
+            "on the target, and on multi-host topologies is an error.  "
+            "Keep fields sharded: slice shard-locally under shard_map "
+            "(lax.dynamic_slice + axis_index), move data with an "
+            "explicit NamedSharding device_put(x, sharding), and stage "
+            "host reads through the designed sync points "
+            "(analysis/runtime.sanctioned_transfer).",
+        ),
+        Rule(
             "JX012",
             "direct jax.profiler use outside the obs layer",
             "jax.profiler.start_trace/stop_trace/TraceAnnotation called "

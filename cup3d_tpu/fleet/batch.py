@@ -163,31 +163,41 @@ def _upload_lane_gait(gaits, lane, gait):
     return {k: gaits[k].at[lane].set(gait[k]) for k in gaits}
 
 
-def reseed_lane_carry(carry, lane, solo, nsteps):
+def reseed_lane_carry(carry, lane, solo, nsteps, mesh=None):
     """Splice a fresh job's solo carry into lane ``lane`` of a batched
     carry (per-lane upload, NOT a host restack): the continuous-batching
     reseed primitive.  ``solo`` is an init_*_carry output for the same
     bucket signature; ``nsteps`` becomes the lane's ``left`` budget.
     Like the rollback selects (fleet/isolate.py) the result is a new
     carry — the input is not donated, so in-flight consumers of the old
-    buffers stay valid."""
+    buffers stay valid.  With a ``mesh`` the update runs shard-local
+    (:func:`_sharded_lane_upload`) so reseeding a mesh-resident carry
+    never gathers it to one device."""
     solo = {k: jnp.asarray(solo[k]) for k in carry if k != LEFT}
-    return _upload_lane_carry(
-        carry, jnp.asarray(lane, jnp.int32), solo,
-        jnp.asarray(nsteps, jnp.int32))
+    up = (_sharded_lane_upload(mesh) if mesh is not None
+          else _upload_lane_carry)
+    return up(carry, jnp.asarray(lane, jnp.int32), solo,
+              jnp.asarray(nsteps, jnp.int32))
 
 
-def reseed_lane_gaits(gaits, lane, gait):
+def reseed_lane_gaits(gaits, lane, gait, mesh=None):
     """Swap one lane's row of the stacked frozen-gait pytree (fish
     bucket reseed); None passes through for gait-free bodies.  The new
     gait must share the batch's parameter set and leaf shapes — reseeds
     are same-signature by construction (fleet/server.py matches on the
-    static signature before calling this)."""
+    static signature before calling this).  ``mesh`` routes the update
+    through the shard-local upload like :func:`reseed_lane_carry`."""
     if gaits is None:
         return None
     if sorted(gait) != sorted(gaits):
         raise ValueError("reseed gait disagrees with the batch gait set")
     solo = {k: jnp.asarray(gait[k], gaits[k].dtype) for k in gaits}
+    if mesh is not None:
+        # gait rows ride the same shard-local update as carry rows (the
+        # gait pytree has no LEFT key, so nsteps is inert)
+        return _sharded_lane_upload(mesh)(
+            gaits, jnp.asarray(lane, jnp.int32), solo,
+            jnp.asarray(0, jnp.int32))
     return _upload_lane_gait(gaits, jnp.asarray(lane, jnp.int32), solo)
 
 
@@ -205,21 +215,113 @@ def lane_track_id(batch_id: int, lane: int) -> int:
 
 
 def fleet_mesh() -> Optional["jax.sharding.Mesh"]:
-    """The optional lanes mesh: a 1-D device mesh named ``lanes`` when
-    CUP3D_FLEET_MESH is on and more than one device is visible, else
-    None (pure vmap on the default device)."""
-    if os.environ.get("CUP3D_FLEET_MESH", "0").lower() not in (
-            "1", "true", "on"):
-        return None
-    devs = jax.devices()
-    if len(devs) < 2:
-        return None
-    return jax.sharding.Mesh(np.asarray(devs), ("lanes",))
+    """The optional fleet mesh behind CUP3D_FLEET_MESH: now the 2-D
+    ``(lanes, x)`` factory (parallel/topology.fleet_mesh2d), whose
+    ``CUP3D_MESH`` auto default of ``(ndevices, 1)`` reproduces the old
+    1-D lanes mesh bit-for-bit as the L-by-1 special case.  None keeps
+    the pure-vmap single-device fleet."""
+    from cup3d_tpu.parallel import topology as topo
+
+    return topo.fleet_mesh2d()
 
 
 def mesh_lane_multiple(mesh) -> int:
-    """Lane counts must divide evenly over the mesh; 1 when unsharded."""
+    """Lane counts must divide evenly over the mesh; 1 when unsharded.
+    On the 2-D mesh the batch axis shards over EVERY mesh device (the
+    lane axis flattens across ``lanes`` and ``x``), so the multiple is
+    the full device count."""
     return int(mesh.devices.size) if mesh is not None else 1
+
+
+def resolve_fleet_mesh(n_lanes: int, mesh) -> Optional[
+        "jax.sharding.Mesh"]:
+    """The loud mesh gate: the mesh the fleet will actually use for a
+    batch of ``n_lanes``.  A lane count that does not divide over the
+    mesh devices cannot shard evenly — the fleet then falls back to the
+    unsharded vmap advance, visibly: a warning, the
+    ``fleet.mesh_fallbacks`` counter, and a None that callers store in
+    place of the mesh (so /health and the CLI report the shard state
+    that is really running, not the one that was asked for)."""
+    if mesh is None:
+        return None
+    mult = mesh_lane_multiple(mesh)
+    if n_lanes % mult == 0:
+        return mesh
+    import warnings
+
+    from cup3d_tpu.obs import metrics as M
+
+    warnings.warn(
+        f"{n_lanes} lanes do not divide over the {mult}-device fleet "
+        f"mesh {dict(mesh.shape)}: batch runs unsharded", stacklevel=2)
+    M.counter("fleet.mesh_fallbacks").inc()
+    return None
+
+
+#: per-mesh memo of the shard_map'd lane-upload executables (one entry
+#: per live mesh; jit's own cache keys the shapes under it)
+_SHARDED_UPLOADS: dict = {}
+
+
+def _sharded_lane_upload(mesh):
+    """The round-17 reseed upload for a mesh-sharded carry: a
+    shard_map'd dynamic-update-slice in LOCAL lane coordinates.  A
+    plain ``.at[lane].set`` on a sharded carry would make the SPMD
+    partitioner materialize cross-device gathers around the update;
+    here every shard computes its flat shard id, rebases ``lane`` into
+    its own block, and applies a where-masked one-row update — the
+    owning shard writes, every other shard reproduces its bits
+    untouched.  Memoized per mesh so steady-state reseeding never
+    retraces."""
+    fn = _SHARDED_UPLOADS.get(mesh)
+    if fn is not None:
+        return fn
+    from jax.sharding import PartitionSpec as P
+
+    from cup3d_tpu.parallel.compat import shard_map
+
+    axes = tuple(mesh.axis_names)
+    minor = int(mesh.shape[axes[1]]) if len(axes) > 1 else 1
+
+    def upload(carry, lane, solo, nsteps):
+        sid = jax.lax.axis_index(axes[0])
+        if len(axes) > 1:
+            sid = sid * minor + jax.lax.axis_index(axes[1])
+        some = next(iter(carry.values()))
+        bl = some.shape[0]  # local lanes per shard (B // nshards)
+        loc = lane - sid * bl
+        ok = (loc >= 0) & (loc < bl)
+        locc = jnp.clip(loc, 0, bl - 1)
+
+        def upd(v, row):
+            cur = jax.lax.dynamic_slice_in_dim(v, locc, 1, axis=0)
+            new = jnp.where(ok, row[None].astype(v.dtype), cur)
+            return jax.lax.dynamic_update_slice_in_dim(
+                v, new, locc, axis=0)
+
+        out = {}
+        for k, v in carry.items():
+            if k == LEFT:
+                out[k] = upd(v, nsteps)
+            else:
+                out[k] = upd(v, solo[k])
+        return out
+
+    def specs(tree):
+        return jax.tree_util.tree_map(lambda _: P(axes), tree)
+
+    def wrapped(carry, lane, solo, nsteps):
+        sm = shard_map(
+            upload, mesh,
+            in_specs=(specs(carry), P(),
+                      jax.tree_util.tree_map(lambda _: P(), solo), P()),
+            out_specs=specs(carry),
+            check_vma=False)
+        return sm(carry, lane, solo, nsteps)
+
+    fn = jax.jit(wrapped)
+    _SHARDED_UPLOADS[mesh] = fn
+    return fn
 
 
 def build_fleet_advance(s, ob=None, mesh=None, kind=None):
@@ -270,7 +372,10 @@ def build_fleet_advance(s, ob=None, mesh=None, kind=None):
 
         from cup3d_tpu.parallel.compat import shard_map
 
-        lanes = P("lanes")
+        # the batch axis shards over the FLATTENED mesh (2-D (lanes, x)
+        # or the legacy 1-D (lanes,)): the body is collective-free, so
+        # each device runs the vmapped advance over its lane block
+        lanes = P(tuple(mesh.axis_names))
         advance = shard_map(
             advance, mesh,
             in_specs=(lanes, lanes, lanes),

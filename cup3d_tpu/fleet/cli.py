@@ -17,6 +17,11 @@ Round 17: ``--policy fifo|srb`` picks the continuous-batching reseed
 order, ``--queue-depth``/``--tenant-quota`` set the admission-control
 knobs, and ``--no-continuous`` falls back to the legacy
 generation-drain (the occupancy baseline).
+
+Round 18: ``--mesh`` prints the resolved 2-D (lanes, x) device-mesh
+state JSON (parallel/topology.py mesh_state: axes, shape, per-device
+placement, fallback count) before the drain — the operator's one-look
+answer to "did the fleet actually shard, and across what".
 """
 
 from __future__ import annotations
@@ -56,6 +61,10 @@ def _build_parser(slo: bool) -> argparse.ArgumentParser:
                     help="legacy generation-drain instead of "
                          "continuous batching "
                          "(CUP3D_FLEET_CONTINUOUS=0)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="print the resolved 2-D device-mesh state "
+                         "JSON on stderr before draining "
+                         "(CUP3D_FLEET_MESH)")
     if slo:
         ap.add_argument("--slo-p99", type=float, default=None,
                         help="target p99 end-to-end seconds "
@@ -96,6 +105,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          policy=args.policy,
                          max_queue_depth=args.queue_depth,
                          tenant_quota=args.tenant_quota)
+    if args.mesh:
+        from cup3d_tpu.obs import metrics as M
+        from cup3d_tpu.parallel import topology as topo
+
+        # stderr so the stdout summary/SLO JSON stays machine-parseable
+        print(json.dumps(topo.mesh_state(
+            server.mesh,
+            fallbacks=int(M.counter("fleet.mesh_fallbacks").value)),
+            sort_keys=True), file=sys.stderr)
     for i, sc in enumerate(scenarios):
         server.submit(sc.get("tenant", f"tenant-{i}"), sc)
     summary = server.drain()

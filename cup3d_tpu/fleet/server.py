@@ -86,6 +86,8 @@ from cup3d_tpu.grid.bucket import count_capacity
 from cup3d_tpu.obs import flight as _flight
 from cup3d_tpu.obs import metrics as M
 from cup3d_tpu.obs import trace as OT
+from cup3d_tpu.parallel import topology as topo
+from cup3d_tpu.resilience import faults
 from cup3d_tpu.sim.dtpolicy import ramped_cfl
 from cup3d_tpu.sim.megaloop import (
     DEFAULT_SCAN_K,
@@ -108,6 +110,10 @@ LANE_LADDER_BASE = 2
 #: scheduler policies: FIFO (submit order) and shortest-remaining-budget
 #: (smallest nsteps first, cutting p99 under skewed job lengths)
 POLICIES = ("fifo", "srb")
+
+#: sentinel so FleetServer(mesh=None) means "explicitly unsharded"
+#: while omitting it means "resolve via fleet_mesh()/CUP3D_FLEET_MESH"
+_MESH_DEFAULT = object()
 
 
 class FleetAdmissionError(RuntimeError):
@@ -428,13 +434,20 @@ class FleetBatch:
         self.carry = FB.stack_carries(carries, targets)
         self.gaits = FB.stack_gaits(gaits, s.dtype) if gaits else None
         ob = s.obstacles[0] if kind == "fish" else None
+        # the batch's actual mesh: the server's, downgraded loudly to
+        # None when B does not divide over it (fleet.mesh_fallbacks) —
+        # health()/the CLI report THIS, the shard state really running
+        self.mesh = FB.resolve_fleet_mesh(self.B, server.mesh)
+        #: lanes on failed mesh slices (resilience/elastic.fail_shard):
+        #: never reseed targets again, frozen at zero budget
+        self.dead_lanes: Set[int] = set()
         #: the static bucket signature — reseed compatibility is THIS
         #: (the step-budget rung only shapes first assembly; it does
         #: not enter the executable key, so cross-rung reseeds still
         #: hit the compiled-advance cache)
         self.sig = _static_signature(template, kind)
         self.advance = server.executable(
-            self.sig, s, ob, self.B, self.K, kind=kind)
+            self.sig, s, ob, self.B, self.K, kind=kind, mesh=self.mesh)
 
         self.step_h = np.zeros(self.B, np.int64)
         self.left_h = np.asarray(targets, np.int64)
@@ -484,9 +497,37 @@ class FleetBatch:
                 cfl[lane, k] = ramped_cfl(base, step0 + k, ramp)
         return cfl
 
+    def nshards(self) -> int:
+        """Mesh slices this batch spans (1 when unsharded)."""
+        return (int(self.mesh.devices.size)
+                if self.mesh is not None else 1)
+
+    def lane_shard(self, lane: int) -> int:
+        """The mesh slice owning ``lane`` (occupancy/SLO shard labels;
+        0 when unsharded)."""
+        from cup3d_tpu.resilience import elastic as EL
+
+        return EL.shard_of_lane(self.B, self.nshards(), lane)
+
+    def fail_shard(self, shard: int) -> List[str]:
+        """Drop one mesh slice: freeze its lane block, requeue its
+        running jobs onto the queue for surviving shards (per-slice
+        elastic recovery, resilience/elastic.py)."""
+        from cup3d_tpu.resilience import elastic as EL
+
+        return EL.fail_shard(self, shard)
+
     def dispatch(self) -> None:
         """One batched advance: every live lane moves K steps, one QoI
         block goes onto the stream."""
+        # the shard-loss seam fires per mesh slice at the K-boundary
+        # (shard index in the step slot, the fleet.lane_nan idiom one
+        # level up); the dead slice's lanes drop out of this dispatch
+        for shard in range(self.nshards()):
+            if shard in {self.lane_shard(d) for d in self.dead_lanes}:
+                continue
+            if faults.fire("fleet.shard_loss", step=shard):
+                self.fail_shard(shard)
         valid = np.minimum(self.left_h, self.K).astype(np.int64)
         if self._undispatched:
             for lane in sorted(self._undispatched):
@@ -515,6 +556,17 @@ class FleetBatch:
         M.counter("fleet.dispatches").inc()
         M.counter("fleet.busy_lane_steps").inc(busy)
         M.counter("fleet.total_lane_steps").inc(self.B * self.K)
+        ns = self.nshards()
+        if ns > 1:
+            # shard-labeled occupancy (round 18): which mesh slice the
+            # busy lane-steps ran on, additive next to the totals
+            bl = self.B // ns
+            for shard in range(ns):
+                sb = int(valid[shard * bl:(shard + 1) * bl].sum())
+                M.counter("fleet.shard_busy_lane_steps",
+                          shard=str(shard)).inc(sb)
+                M.counter("fleet.shard_total_lane_steps",
+                          shard=str(shard)).inc(bl * self.K)
         if self._since_snap >= self.snap_dispatches:
             self.settle()
             self.guard.snapshot(self.carry, self.step_h, self.left_h)
@@ -614,10 +666,12 @@ class FleetBatch:
     def free_lanes(self) -> List[int]:
         """Lanes holding no RUNNING job — padding or retired — i.e.
         reseed targets for the continuous scheduler.  Callers settle
-        the stream first so pending retirements are visible."""
+        the stream first so pending retirements are visible.  Lanes on
+        a lost mesh slice (``dead_lanes``) are never reseed targets."""
         return [lane for lane in range(self.B)
-                if self.jobs[lane] is None
-                or self.jobs[lane].status != RUNNING]
+                if lane not in self.dead_lanes
+                and (self.jobs[lane] is None
+                     or self.jobs[lane].status != RUNNING)]
 
     def reseed_lane(self, lane: int, job: FleetJob, drv) -> None:
         """Splice a queued job into a freed lane at a K-boundary: a
@@ -628,9 +682,10 @@ class FleetBatch:
         rows drop on the epoch bump."""
         solo, gait = _lane_payload(self.kind, drv, job.job_id)
         self.carry = FB.reseed_lane_carry(
-            self.carry, lane, solo, job.nsteps)
+            self.carry, lane, solo, job.nsteps, mesh=self.mesh)
         if self.gaits is not None:
-            self.gaits = FB.reseed_lane_gaits(self.gaits, lane, gait)
+            self.gaits = FB.reseed_lane_gaits(
+                self.gaits, lane, gait, mesh=self.mesh)
         self.step_h[lane] = 0
         self.left_h[lane] = job.nsteps
         self.guard.reseed(self.carry, lane, job.nsteps)
@@ -694,7 +749,8 @@ class FleetServer:
                  continuous: Optional[bool] = None,
                  policy: Optional[str] = None,
                  max_queue_depth: Optional[int] = None,
-                 tenant_quota: Optional[int] = None):
+                 tenant_quota: Optional[int] = None,
+                 mesh=_MESH_DEFAULT):
         self.max_lanes = int(
             max_lanes if max_lanes is not None
             else _env_int("CUP3D_FLEET_LANES", 64))
@@ -712,7 +768,7 @@ class FleetServer:
         self.batches: List[FleetBatch] = []
         self._next_job = 0
         self._next_batch = 0
-        self.mesh = FB.fleet_mesh()
+        self.mesh = FB.fleet_mesh() if mesh is _MESH_DEFAULT else mesh
         # completion SLO: target p99 end-to-end seconds + rolling
         # per-tenant breach window (health()["slo"], fleet slo CLI)
         self.slo_p99_s = float(
@@ -1029,16 +1085,17 @@ class FleetServer:
         return reseeded
 
     def executable(self, sig: tuple, s, ob, cap: int, K: int,
-                   kind: Optional[str] = None):
+                   kind: Optional[str] = None, mesh=None):
         """The compiled-advance cache, LRU-capped by the buckets knob:
-        one vmapped executable per (signature, lane rung, K)."""
-        key = (sig, int(cap), int(K))
+        one vmapped executable per (signature, lane rung, K, mesh)."""
+        mesh_key = (tuple(mesh.shape.items()) if mesh is not None else None)
+        key = (sig, int(cap), int(K), mesh_key)
         hit = self._execs.pop(key, None)
         if hit is not None:
             self._execs[key] = hit
             M.counter("fleet.executable_hits").inc()
             return hit
-        fn = FB.build_fleet_advance(s, ob, mesh=self.mesh, kind=kind)
+        fn = FB.build_fleet_advance(s, ob, mesh=mesh, kind=kind)
         self._execs[key] = fn
         M.counter("fleet.executable_builds").inc()
         while len(self._execs) > self.max_buckets:
@@ -1088,6 +1145,15 @@ class FleetServer:
         if e2e is not None:
             M.histogram("fleet.job_e2e_s", tenant=job.tenant,
                         bucket=bucket).observe(e2e)
+            # shard-labeled companion (round 18): which mesh slice the
+            # job finished on — a separate family so the existing
+            # tenant/bucket label sets (and their quantile merges) are
+            # untouched by sharding
+            if batch is not None and lane is not None \
+                    and batch.nshards() > 1:
+                M.histogram(
+                    "fleet.shard_job_e2e_s",
+                    shard=str(batch.lane_shard(lane))).observe(e2e)
             wnd = self._slo_windows.setdefault(
                 job.tenant, deque(maxlen=self.slo_window))
             breached = e2e > self.slo_p99_s
@@ -1163,6 +1229,22 @@ class FleetServer:
             "tenants": tenants,
         }
 
+    def shard_loss(self, shard: int) -> List[str]:
+        """Per-slice elastic recovery entry point: drop mesh slice
+        ``shard`` of every live sharded batch.  The lost lanes' RUNNING
+        jobs go back to the queue (from step 0) and land on surviving
+        shards at the next K-boundary; every surviving lane's carry
+        bits are untouched (resilience/elastic.py).  Returns the
+        requeued job ids."""
+        requeued: List[str] = []
+        for b in self.batches:
+            if b.nshards() > 1 and shard < b.nshards():
+                requeued.extend(b.fail_shard(shard))
+        # the jobs are back in the implicit queue (status == QUEUED in
+        # self._jobs); the next _schedule() pass reseeds them onto
+        # surviving-shard lanes
+        return requeued
+
     def update_lane_gauge(self) -> None:
         M.gauge("fleet.lanes_active").set(
             float(sum(b.running_lanes() for b in self.batches)))
@@ -1213,6 +1295,17 @@ class FleetServer:
                 "policy": self.policy,
                 "reseeds": int(self.reseeds),
                 "lane_occupancy": self.last_occupancy,
+            },
+            "mesh": {
+                **topo.mesh_state(
+                    self.mesh,
+                    fallbacks=int(
+                        M.counter("fleet.mesh_fallbacks").value)),
+                "dead_lanes": sorted(
+                    int(lane) for b in self.batches
+                    for lane in b.dead_lanes),
+                "shard_losses": int(
+                    M.counter("fleet.shard_losses").value),
             },
             "knobs": {
                 "max_lanes": self.max_lanes,

@@ -100,6 +100,16 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("detail", "fleet_occupancy")),
         higher_is_better=True,
     ),
+    # round 18 (2-D mesh scale-out): sharded steady-state megaloop
+    # throughput of the mesh2d config (bench.py) — the x-slab scan body
+    # with ring halos; a DROP means the sharded path lost ground to the
+    # solo loop (halo regression, retrace, fallback), higher is better
+    MetricSpec(
+        "mesh_cells_per_s",
+        (("mesh2d", "mesh_cells_per_s"),
+         ("detail", "mesh_cells_per_s")),
+        higher_is_better=True,
+    ),
 )
 
 

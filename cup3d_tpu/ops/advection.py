@@ -22,14 +22,18 @@ RK3_B = (1.0 / 3.0, 15.0 / 16.0, 8.0 / 15.0)
 
 
 def advection_diffusion_rhs(grid: UniformGrid, u: jnp.ndarray, nu: float,
-                            uinf: jnp.ndarray) -> jnp.ndarray:
+                            uinf: jnp.ndarray, pad=None) -> jnp.ndarray:
     """du/dt from advection + diffusion on the uniform grid.
 
     u: (nx, ny, nz, 3) velocity in the body/lab frame.
     uinf: (3,) frame velocity added to the advecting field only.
+    pad: optional ``(u, width) -> padded`` ghost supplier replacing
+    ``grid.pad_vector`` — the x-slab decomposition injects the
+    ring-halo pad (parallel/ring.pad_slab_vector) here so the stencil
+    body itself stays layout-agnostic.
     """
     h = grid.h
-    up = grid.pad_vector(u, GHOSTS)
+    up = grid.pad_vector(u, GHOSTS) if pad is None else pad(u, GHOSTS)
     uadv = [u[..., c] + uinf[c] for c in range(3)]
     out = []
     for c in range(3):
@@ -43,10 +47,11 @@ def advection_diffusion_rhs(grid: UniformGrid, u: jnp.ndarray, nu: float,
 
 
 def rk3_step(grid: UniformGrid, u: jnp.ndarray, dt, nu: float,
-             uinf: jnp.ndarray) -> jnp.ndarray:
+             uinf: jnp.ndarray, pad=None) -> jnp.ndarray:
     """One explicit low-storage RK3 advection-diffusion step."""
     k = jnp.zeros_like(u)
     for a, b in zip(RK3_A, RK3_B):
-        k = a * k + dt * advection_diffusion_rhs(grid, u, nu, uinf)
+        k = a * k + dt * advection_diffusion_rhs(grid, u, nu, uinf,
+                                                 pad=pad)
         u = u + b * k
     return u

@@ -103,6 +103,71 @@ def cached_forest(grid: BlockGrid, mesh: Optional[Mesh] = None
     return forest
 
 
+class ExecutableMemo:
+    """Signature-keyed LRU of compiled step-executable bundles — the
+    round-18 port of PR 3's capacity-bucketing discipline to the forest
+    path.  The sharded forest's duck-typed tables are not pytrees, so
+    its jits close over them and are only reusable for an IDENTICAL
+    topology; equal octree signatures guarantee bitwise-equal tables,
+    so a regrid that returns to a seen topology (the refine->coarsen
+    ping-pong) swaps the whole bundle back in with zero retraces.
+    Hits/misses surface as ``<name>_hits`` / ``<name>_misses``."""
+
+    def __init__(self, max_entries: int = 4,
+                 name: str = "forest.exec_memo"):
+        self.max_entries = int(max_entries)
+        self.name = name
+        self._memo: "OrderedDict[object, dict]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._memo)
+
+    def get(self, sig) -> Optional[dict]:
+        """The bundle compiled for ``sig``, refreshed in LRU order, or
+        None on a genuinely new topology (counted either way)."""
+        from cup3d_tpu.obs import metrics as obs_metrics
+
+        bundle = self._memo.pop(sig, None)
+        obs_metrics.counter(
+            f"{self.name}_hits" if bundle is not None
+            else f"{self.name}_misses"
+        ).inc()
+        if bundle is not None:
+            self._memo[sig] = bundle
+        return bundle
+
+    def put(self, sig, bundle: dict) -> None:
+        self._memo[sig] = bundle
+        while len(self._memo) > self.max_entries:
+            self._memo.popitem(last=False)
+
+
+def bind_step_executable(fn, *bound, donate=()):
+    """One compiled step executable with the forest's (non-pytree)
+    tables closed over as trailing constants: ``fn(*args, *bound)``
+    jitted with ``donate`` naming the caller-facing state argnums.
+
+    This is THE jit-construction site for the forest path — callers on
+    the adaptation path (sim/amr.py ``_rebuild``) bind here and memoize
+    the result by octree signature (:class:`ExecutableMemo`), so a
+    fresh jit object is only ever built once per NEW topology, never
+    per regrid pass (the JX007 hazard class this helper burns down)."""
+    return jax.jit(lambda *a: fn(*a, *bound), donate_argnums=donate)
+
+
+def bind_order_executables(fn, tabs, donate=()) -> tuple:
+    """(first_order, second_order) compiled executables for a pressure-
+    order-switched step body: ``fn(*args, *tabs, second_order=...)``
+    bound per order through :func:`bind_step_executable`.  The caller
+    picks by step index at call time — the order switch is two cached
+    executables, not a retrace."""
+    return tuple(
+        bind_step_executable(partial(fn, second_order=so), *tabs,
+                             donate=donate)
+        for so in (False, True)
+    )
+
+
 class _Exchange:
     """Host-built routing for one (flat-array layout, reference set).
 

@@ -51,6 +51,8 @@ __all__ = [
     "use_ring_halo",
     "ring_shift",
     "ring_all_to_all",
+    "pad_slab_scalar",
+    "pad_slab_vector",
     "make_laplacian_lanes_sharded",
 ]
 
@@ -146,6 +148,83 @@ def ring_all_to_all(send: jnp.ndarray, axis_name: str) -> jnp.ndarray:
             out, got, jax.lax.rem(me - k + D, D), axis=0
         )
     return out
+
+
+def _pad_slab_x(grid: UniformGrid, f: jnp.ndarray, width: int,
+                axis_name: str, comp):
+    """x-ghosts of one (sx, ny, nz) slab: the cross-shard halo by ring
+    permute (issued FIRST, so on TPU the async remote copy flies while
+    the caller's y/z padding computes), with the GLOBAL x boundary
+    reproduced bit-for-bit from grid/uniform._pad — periodic is the
+    natural ring wrap; edge-copy (and the wall/normal-component sign
+    flip) applies only on shard 0 / D-1."""
+    from cup3d_tpu.grid.uniform import BC
+
+    D = jax.lax.psum(1, axis_name)
+    lo_own = jax.lax.slice_in_dim(f, 0, width, axis=0)
+    hi_own = jax.lax.slice_in_dim(f, f.shape[0] - width, f.shape[0],
+                                  axis=0)
+    recv_lo = ring_shift(hi_own, axis_name, shift=+1)
+    recv_hi = ring_shift(lo_own, axis_name, shift=-1)
+    bc = grid.bc[0]
+    if bc == BC.periodic:
+        lo, hi = recv_lo, recv_hi
+    else:
+        me = jax.lax.axis_index(axis_name)
+        edge_lo = jnp.repeat(jax.lax.slice_in_dim(f, 0, 1, axis=0),
+                             width, axis=0)
+        edge_hi = jnp.repeat(
+            jax.lax.slice_in_dim(f, f.shape[0] - 1, f.shape[0], axis=0),
+            width, axis=0)
+        if comp is not None and (bc == BC.wall or comp == 0):
+            edge_lo, edge_hi = -edge_lo, -edge_hi
+        lo = jnp.where(me == 0, edge_lo, recv_lo)
+        hi = jnp.where(me == D - 1, edge_hi, recv_hi)
+    return jnp.concatenate([lo, f, hi], axis=0)
+
+
+def _pad_slab_yz(grid: UniformGrid, f: jnp.ndarray, width: int, comp):
+    """y/z ghosts of an x-padded slab — the unsharded axes, padded with
+    the same sequential per-axis logic as grid/uniform._pad (so the
+    ghost corners match the solo path exactly)."""
+    from cup3d_tpu.grid import uniform as _u
+
+    for axis in (1, 2):
+        bc = grid.bc[axis]
+        if bc == _u.BC.periodic:
+            f = _u._pad_axis(f, axis, width, mode="wrap")
+        else:
+            f = _u._pad_axis(f, axis, width, mode="edge")
+            if comp is not None and (bc == _u.BC.wall or comp == axis):
+                f = _u._negate_ghosts(f, axis, width)
+    return f
+
+
+def pad_slab_scalar(grid: UniformGrid, f: jnp.ndarray, width: int,
+                    axis_name: str) -> jnp.ndarray:
+    """grid.pad_scalar for one x-slab inside shard_map over
+    ``axis_name``: x-ghosts come from the ring halo (plus the global
+    BC at shard 0 / D-1), y/z ghosts from the grid BCs.  Elementwise
+    identical to slicing the solo padded array — the slab stencils
+    built on top inherit bitwise equivalence."""
+    return _pad_slab_yz(grid,
+                        _pad_slab_x(grid, f, width, axis_name, None),
+                        width, None)
+
+
+def pad_slab_vector(grid: UniformGrid, u: jnp.ndarray, width: int,
+                    axis_name: str) -> jnp.ndarray:
+    """grid.pad_vector for one (sx, ny, nz, 3) x-slab inside shard_map:
+    per-component ghosts with the solo path's BC sign flips.  The two
+    ring messages per component are issued before the y/z padding and
+    consumed only in the x-ghost concatenation, preserving the
+    halos-before-interior overlap of make_laplacian_lanes_sharded."""
+    comps = []
+    for c in range(3):
+        comps.append(_pad_slab_yz(
+            grid, _pad_slab_x(grid, u[..., c], width, axis_name, c),
+            width, c))
+    return jnp.stack(comps, axis=-1)
 
 
 def make_laplacian_lanes_sharded(grid: UniformGrid, mesh: Mesh,

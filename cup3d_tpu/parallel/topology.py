@@ -1,0 +1,307 @@
+"""2-D (lane x space) device-mesh topology layer (round 18).
+
+The reference scales the 512^3 fish case over 64 MPI ranks; our stack
+stopped at one host's devices, with two *independent* 1-D shardings
+bolted on ad hoc: ``fleet/batch.fleet_mesh()`` (a lanes-only mesh) and
+``parallel/mesh.make_mesh`` (an x/y field mesh the fleet never sees).
+This module subsumes both behind one factory:
+
+- :func:`dist_init` — optional multi-process ``jax.distributed``
+  bring-up.  ``CUP3D_DIST=auto`` initializes from the cluster env
+  (TPU pods auto-detect), ``coordinator:port`` is the explicit form
+  (with ``CUP3D_DIST_NPROCS`` / ``CUP3D_DIST_RANK``), ``0`` (default)
+  is a no-op.  Single-process runs never pay anything: the call is
+  idempotent and failure-tolerant (state is reported, not raised).
+- :func:`make_mesh2d` — the canonical 2-D ``Mesh(("lanes", "x"))``
+  over a DETERMINISTIC device order (sorted by ``(process_index,
+  id)``), shaped by ``CUP3D_MESH=LxX`` or explicit arguments; the
+  default ``(ndevices, 1)`` is exactly the old 1-D lanes mesh, so
+  every existing fleet path is the L-by-1 special case.
+- :func:`placement_map` — the lane-shard/x-shard -> device/host map,
+  row-major over the mesh array; deterministic by construction
+  because the device order is.  This is what replaces the
+  reference's rank-to-subtree bookkeeping (SynchronizerMPI_AMR):
+  placement is a pure function of the sorted device list, never of
+  arrival order.
+- :func:`fleet_mesh2d` / :func:`megaloop_mesh` — the two consumers'
+  entry points: the fleet's batch mesh (``CUP3D_FLEET_MESH`` gate,
+  lanes-major) and the solo megaloop's slab mesh (``CUP3D_MESH_X``
+  gate, x-major with a unit lanes axis).
+
+Everything here is exercised on CPU via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the tests'
+conftest) — the mesh factory does not care what backs the devices.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cup3d_tpu.obs import metrics as M
+
+__all__ = [
+    "dist_init",
+    "dist_state",
+    "device_order",
+    "make_mesh2d",
+    "mesh_axis_size",
+    "placement_map",
+    "mesh_state",
+    "fleet_mesh2d",
+    "megaloop_mesh",
+    "shard_carry",
+]
+
+#: mesh axis names, in array order: leading = scenario lanes, trailing =
+#: the x slab axis of the spatial domain decomposition
+LANE_AXIS = "lanes"
+X_AXIS = "x"
+
+#: module-level distributed-init state (idempotence + health reporting)
+_DIST = {"mode": "off", "initialized": False, "error": None,
+         "processes": 1, "rank": 0}
+
+
+def dist_state() -> dict:
+    """A copy of the last :func:`dist_init` outcome (health payloads)."""
+    return dict(_DIST)
+
+
+def dist_init(spec: Optional[str] = None) -> dict:
+    """Bring up ``jax.distributed`` per ``CUP3D_DIST`` and return the
+    resulting state dict (also kept for :func:`dist_state`).
+
+    ``spec`` (default: the ``CUP3D_DIST`` env var, default ``"0"``):
+
+    - ``"0"`` / ``"off"`` / empty — no-op (single-process, the normal
+      CPU/test path).
+    - ``"auto"`` — ``jax.distributed.initialize()`` with cluster
+      auto-detection, but ONLY when ``CUP3D_DIST_NPROCS`` declares
+      more than one process; a single process stays a no-op so local
+      runs with ``CUP3D_DIST=auto`` in the environment never hang on
+      a coordinator that does not exist.
+    - ``"host:port"`` — explicit coordinator; ``CUP3D_DIST_NPROCS``
+      and ``CUP3D_DIST_RANK`` supply the process count and this
+      process's id.
+
+    Idempotent: a second call (or an interpreter where somebody else
+    already initialized) records ``initialized`` and returns.  Failures
+    are recorded in ``state["error"]`` and counted
+    (``topology.dist_init_errors``), never raised — a megaloop run must
+    not die because the topology layer could not find its peers."""
+    if spec is None:
+        spec = os.environ.get("CUP3D_DIST", "0")
+    spec = spec.strip().lower()
+    if spec in ("", "0", "off", "false", "no"):
+        _DIST.update(mode="off", initialized=False, error=None,
+                     processes=1, rank=0)
+        return dist_state()
+    nprocs = int(os.environ.get("CUP3D_DIST_NPROCS", "1"))
+    rank = int(os.environ.get("CUP3D_DIST_RANK", "0"))
+    if _DIST["initialized"]:
+        return dist_state()
+    if spec == "auto" and nprocs <= 1:
+        # single process asked for auto: nothing to coordinate
+        _DIST.update(mode="single", initialized=False, error=None,
+                     processes=1, rank=0)
+        return dist_state()
+    try:
+        if spec == "auto":
+            jax.distributed.initialize()
+        else:
+            jax.distributed.initialize(
+                coordinator_address=spec,
+                num_processes=nprocs,
+                process_id=rank,
+            )
+        _DIST.update(mode=spec, initialized=True, error=None,
+                     processes=jax.process_count(),
+                     rank=jax.process_index())
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            _DIST.update(mode=spec, initialized=True, error=None,
+                         processes=jax.process_count(),
+                         rank=jax.process_index())
+        else:
+            _DIST.update(mode=spec, initialized=False, error=str(e))
+            M.counter("topology.dist_init_errors").inc()
+    except Exception as e:  # noqa: BLE001 — report, never crash the run
+        _DIST.update(mode=spec, initialized=False, error=str(e))
+        M.counter("topology.dist_init_errors").inc()
+    return dist_state()
+
+
+def device_order(devices: Optional[Sequence] = None) -> List:
+    """The canonical device order every mesh here is built from:
+    sorted by ``(process_index, id)``.  ``jax.devices()`` is usually
+    already in this order, but sorting makes the lane<->host placement
+    a deterministic function of the device set rather than of
+    enumeration order."""
+    if devices is None:
+        devices = jax.devices()
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def _parse_mesh_env() -> Optional[Tuple[int, int]]:
+    """``CUP3D_MESH="LxX"`` -> (lanes, x); None for unset/auto."""
+    v = os.environ.get("CUP3D_MESH", "").strip().lower()
+    if not v or v == "auto":
+        return None
+    try:
+        lanes_s, x_s = v.split("x", 1)
+        return max(1, int(lanes_s)), max(1, int(x_s))
+    # jax-lint: allow(JX009, malformed CUP3D_MESH falls back to the
+    # auto shape; the resolved mesh is surfaced by mesh_state() in the
+    # fleet /health payload and the CLI --mesh flag)
+    except ValueError:
+        return None
+
+
+def make_mesh2d(lanes: Optional[int] = None, x: Optional[int] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """The 2-D ``Mesh(("lanes", "x"))`` over the canonical device order.
+
+    Shape resolution, in priority order: explicit ``(lanes, x)``
+    arguments, then ``CUP3D_MESH="LxX"``, then the auto default
+    ``(ndevices, 1)`` — which is bit-for-bit the old 1-D lanes mesh
+    with a unit x axis, so the factory *subsumes* ``fleet_mesh()``.
+    Giving only one axis derives the other (``ndevices`` must divide
+    evenly); a shape that does not multiply out to the device count
+    raises — the silently-replicating degenerate meshes are exactly
+    what round 12's ``_factor2(divide=)`` guard rejects on the field
+    mesh, and the topology layer holds the same line."""
+    devs = device_order(devices)
+    nd = len(devs)
+    if lanes is None and x is None:
+        env = _parse_mesh_env()
+        if env is not None:
+            lanes, x = env
+    if lanes is None and x is None:
+        lanes, x = nd, 1
+    elif lanes is None:
+        if nd % x:
+            raise ValueError(
+                f"{nd} devices do not factor over x={x}: pick an x "
+                f"axis dividing the device count")
+        lanes = nd // x
+    elif x is None:
+        if nd % lanes:
+            raise ValueError(
+                f"{nd} devices do not factor over lanes={lanes}")
+        x = nd // lanes
+    if lanes * x != nd:
+        raise ValueError(
+            f"mesh shape ({lanes} lanes x {x}) needs {lanes * x} "
+            f"devices, {nd} visible: fix CUP3D_MESH or the device set")
+    arr = np.asarray(devs, dtype=object).reshape(lanes, x)
+    return Mesh(arr, (LANE_AXIS, X_AXIS))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    """Size of one named mesh axis (1 for a name the mesh lacks, so
+    1-D legacy meshes read as x=1)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return int(sizes.get(axis, 1))
+
+
+def placement_map(mesh: Mesh) -> List[dict]:
+    """The deterministic lane-shard/x-shard -> device/host table,
+    row-major over the mesh array.  Because :func:`make_mesh2d` builds
+    from the sorted device order, two processes constructing the same
+    mesh agree on every entry — the property the per-slice recovery
+    layer (resilience/elastic.py) relies on to name a lost shard."""
+    shape = mesh.devices.shape
+    out = []
+    for flat, dev in enumerate(mesh.devices.flat):
+        coords = np.unravel_index(flat, shape)
+        out.append({
+            "lane_shard": int(coords[0]),
+            "x_shard": int(coords[-1]) if len(shape) > 1 else 0,
+            "device_id": int(dev.id),
+            "process": int(dev.process_index),
+            "platform": str(dev.platform),
+        })
+    return out
+
+
+def mesh_state(mesh: Optional[Mesh], fallbacks: int = 0) -> dict:
+    """JSON-able mesh/shard state for ``/health`` and the fleet CLI."""
+    if mesh is None:
+        return {"active": False, "axes": [], "shape": [],
+                "devices": 0, "fallbacks": int(fallbacks),
+                "dist": dist_state()}
+    return {
+        "active": True,
+        "axes": list(mesh.axis_names),
+        "shape": [int(v) for v in mesh.devices.shape],
+        "devices": int(mesh.devices.size),
+        "fallbacks": int(fallbacks),
+        "placement": placement_map(mesh),
+        "dist": dist_state(),
+    }
+
+
+def fleet_mesh2d() -> Optional[Mesh]:
+    """The fleet's batch mesh: the 2-D factory behind the legacy
+    ``CUP3D_FLEET_MESH`` gate.  None when the gate is off or only one
+    device is visible (pure vmap); otherwise ``(lanes, x)`` from
+    ``CUP3D_MESH`` with the ``(ndevices, 1)`` auto default — the old
+    1-D lanes mesh as the L-by-1 special case."""
+    if os.environ.get("CUP3D_FLEET_MESH", "0").lower() not in (
+            "1", "true", "on"):
+        return None
+    dist_init()
+    if len(jax.devices()) < 2:
+        return None
+    return make_mesh2d()
+
+
+def megaloop_mesh() -> Optional[Mesh]:
+    """The solo megaloop's slab mesh: ``CUP3D_MESH_X=D`` asks for a
+    ``(1, D)`` mesh (unit lane axis, D x-slabs).  None when unset,
+    <2, or more slabs than devices are requested — the caller falls
+    back to the unsharded megaloop, loudly
+    (``topology.megaloop_mesh_fallbacks``)."""
+    v = os.environ.get("CUP3D_MESH_X", "").strip()
+    if not v:
+        return None
+    try:
+        want = int(v)
+    # jax-lint: allow(JX009, malformed CUP3D_MESH_X disables the slab
+    # mesh; the fallback is counted below so it is observable)
+    except ValueError:
+        want = 0
+    if want < 2:
+        return None
+    dist_init()
+    if len(jax.devices()) < want:
+        warnings.warn(
+            f"CUP3D_MESH_X={want} exceeds the {len(jax.devices())} "
+            f"visible devices: megaloop runs unsharded", stacklevel=2)
+        M.counter("topology.megaloop_mesh_fallbacks").inc()
+        return None
+    return make_mesh2d(lanes=1, x=want,
+                       devices=device_order()[:want])
+
+
+#: megaloop carry keys laid out (nx, ny, nz[, 3]) and slab-sharded on
+#: the x axis; every other key (umax/time/dt/rigid/qint/left) replicates
+FIELD_KEYS = frozenset({"vel", "p", "chi", "udef"})
+
+
+def shard_carry(carry: dict, mesh: Mesh, axis: str = X_AXIS) -> dict:
+    """Place a megaloop carry on the mesh: field leaves slab-sharded
+    over ``axis``, scalar chain replicated.  Callers use this before
+    the first sharded-megaloop dispatch so donation lines up (a carry
+    living on one device would be resharded, not donated)."""
+    out = {}
+    for k, v in carry.items():
+        spec = P(axis) if k in FIELD_KEYS else P()
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out

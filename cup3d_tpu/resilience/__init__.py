@@ -17,6 +17,7 @@ Env knobs (full catalog in README "Resilience"):
 - ``CUP3D_DT_FLOOR``    lower bound for the retry dt halving (1e-9).
 """
 
+from cup3d_tpu.resilience import elastic  # noqa: F401 (public surface)
 from cup3d_tpu.resilience import faults  # noqa: F401 (public surface)
 from cup3d_tpu.resilience.recovery import (  # noqa: F401
     RecoveryEngine,

@@ -1,0 +1,101 @@
+"""Per-slice elastic recovery for the mesh-sharded fleet (round 18).
+
+A fleet batch sharded over the 2-D ``(lanes, x)`` mesh places a
+contiguous block of ``B / nshards`` lanes on each mesh device (the
+shard_map batch split in fleet/batch.build_fleet_advance).  When a
+shard drops out — a preempted TPU slice, a failed host — ONLY that
+block is lost: every surviving lane's carry bits are untouched (the
+batch carry is never gathered or rewritten here), and the lost lanes'
+jobs go back to the queue to be reseeded onto surviving shards at the
+next K-boundary by the continuous scheduler (fleet/server._schedule).
+
+The slice loss itself is injectable like every other failure seam:
+the ``fleet.shard_loss`` fault site (resilience/faults.py) is armed
+with the SHARD index in the step slot — the fleet.lane_nan idiom one
+level up — and consulted per shard at each dispatch boundary.
+
+Engine contract (exercised by tests/test_topology.py):
+
+- the dead shard's lanes join ``batch.dead_lanes`` and are never again
+  reseed targets (``FleetBatch.free_lanes`` excludes them);
+- each lost RUNNING job is requeued from step 0 (its row buffer and
+  step mirrors reset — rollback to the initial snapshot; partial rows
+  from the dead slice are not trusted);
+- in-flight QoI rows of lost lanes drop on the lane-guard epoch bump,
+  so a late stream consume cannot resurrect them;
+- counters: ``fleet.shard_losses`` per slice, ``fleet.elastic_requeues``
+  per recovered job.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from cup3d_tpu.obs import metrics as M
+
+__all__ = [
+    "lanes_of_shard",
+    "shard_of_lane",
+    "fail_shard",
+]
+
+
+def lanes_of_shard(n_lanes: int, nshards: int, shard: int) -> range:
+    """The contiguous lane block living on mesh shard ``shard`` —
+    shard_map splits the leading batch axis into ``nshards`` equal
+    blocks in flat device order, so block ``s`` is lanes
+    ``[s * B/nshards, (s+1) * B/nshards)``."""
+    if n_lanes % nshards:
+        raise ValueError(
+            f"{n_lanes} lanes do not split over {nshards} shards")
+    bl = n_lanes // nshards
+    if not 0 <= shard < nshards:
+        raise ValueError(f"shard {shard} outside [0, {nshards})")
+    return range(shard * bl, (shard + 1) * bl)
+
+
+def shard_of_lane(n_lanes: int, nshards: int, lane: int) -> int:
+    """Inverse of :func:`lanes_of_shard` (occupancy/SLO shard labels)."""
+    return int(lane) // (n_lanes // nshards)
+
+
+def fail_shard(batch, shard: int) -> List[str]:
+    """Fail one mesh slice of a fleet batch: freeze its lane block,
+    requeue its RUNNING jobs, leave every other lane untouched.
+    Returns the requeued job ids (test hook).
+
+    The batch carry is deliberately NOT rewritten: the dead lanes are
+    fenced host-side (``left`` budget zero at the next dispatch via
+    ``left_h``, epoch bump for in-flight rows, exclusion from
+    ``free_lanes``), which is exactly how padding lanes are already
+    kept inert — so the surviving lanes' device bits stay identical to
+    a run where the slice never existed."""
+    nshards = batch.nshards()
+    lanes = lanes_of_shard(batch.B, nshards, shard)
+    M.counter("fleet.shard_losses").inc()
+    requeued: List[str] = []
+    for lane in lanes:
+        batch.dead_lanes.add(int(lane))
+        batch.left_h[lane] = 0
+        batch.guard.epochs[lane] += 1
+        job = batch.jobs[lane]
+        batch.jobs[lane] = None
+        if job is None or job.status != "running":
+            continue
+        # rollback to the initial snapshot: the job restarts from step
+        # 0 on whatever shard the scheduler reseeds it onto
+        job.status = "queued"
+        job.batch = None
+        job.lane = -1
+        job.steps_done = 0
+        job.time = 0.0
+        if job.rows is not None:
+            job.rows[:] = 0.0
+        job.mark("shard_lost")
+        job.mark("queued")
+        M.counter("fleet.elastic_requeues").inc()
+        requeued.append(job.job_id)
+    batch.server.update_lane_gauge()
+    return requeued

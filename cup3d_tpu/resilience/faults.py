@@ -54,6 +54,11 @@ SITES = (
     # slot, it poisons exactly one chosen lane's QoI chain at its next
     # consumed row (fleet/isolate.py check_row)
     "fleet.lane_nan",
+    # shard-addressed fleet seam (round 18): armed with the SHARD index
+    # in the step slot, it drops that mesh slice of every live batch at
+    # the next dispatch boundary (resilience/elastic.fail_shard via
+    # fleet/server.FleetBatch.dispatch)
+    "fleet.shard_loss",
 )
 
 ENV_VAR = "CUP3D_FAULT"

@@ -270,8 +270,12 @@ class AMRSimulation:
         self._exec_cache: Dict = {}   # bucket key -> jitted executables
         # octree signature -> the forest path's full executable bundle
         # (closure-style jits can only be reused for an IDENTICAL
-        # topology, so the memo key is the signature, not the bucket)
-        self._forest_memo: Dict = {}
+        # topology, so the memo key is the signature, not the bucket);
+        # round 18: the memo discipline lives in parallel/forest.py
+        from cup3d_tpu.parallel.forest import ExecutableMemo
+
+        self._forest_memo = ExecutableMemo(
+            max_entries=4, name="forest.exec_memo")
         self._solver_core = None
         # round-10 resilience: simulate() installs a RecoveryEngine here
         # (CUP3D_RECOVER=1, the default); the Poisson escalation ladder
@@ -392,16 +396,9 @@ class AMRSimulation:
             # rebind the memoized executable bundle: zero retraces, zero
             # table rebuilds (parallel/forest.py cached_forest shares
             # the key discipline)
-            from cup3d_tpu.obs import metrics as obs_metrics
-
             sig = g.signature
-            memo = self._forest_memo.pop(sig, None)
-            obs_metrics.counter(
-                "forest.exec_memo_hits" if memo is not None
-                else "forest.exec_memo_misses"
-            ).inc()
+            memo = self._forest_memo.get(sig)
             if memo is not None:
-                self._forest_memo[sig] = memo
                 for k, v in memo.items():
                     setattr(self, k, v)
                 return
@@ -466,14 +463,14 @@ class AMRSimulation:
             # both paths).  Donated args are the step state buffers the
             # caller rebinds from the return value (JX002 burn-down).
             if self.forest is not None:
-                # jax-lint: allow(JX007, forest path traces once per NEW
-                # octree signature: its duck-typed sharded tables are not
-                # pytrees, so the whole executable bundle is memoized by
-                # signature instead (_forest_memo; zero steady-state
-                # retraces across the regrid ping-pong))
-                jf = jax.jit(lambda *a: fn(*a, *bound),
-                             donate_argnums=donate)
-                return jf
+                # the jit construction site lives in parallel/forest.py
+                # (bind_step_executable), outside the adaptation path:
+                # a NEW octree signature binds once and the bundle rides
+                # _forest_memo after (zero steady-state retraces across
+                # the regrid ping-pong — the JX007 burn-down)
+                from cup3d_tpu.parallel.forest import bind_step_executable
+
+                return bind_step_executable(fn, *bound, donate=donate)
             # jax-lint: allow(JX007, legacy CUP3D_BUCKET=0 path kept as
             # the bucketing equivalence baseline (tests/test_bucketing);
             # production single-device runs use _rebuild_bucketed)
@@ -623,19 +620,20 @@ class AMRSimulation:
                 delta = u_target - u_msr
                 return vel.at[..., 0].add(delta * profile), u_msr
 
-            # jax-lint: allow(JX007, closes over this layout's profile +
-            # vol_total; a NEW forest topology traces once and joins the
+            # jit construction via parallel/forest.bind_step_executable
+            # (the JX007 burn-down): closes over this layout's profile +
+            # vol_total; a NEW forest topology binds once and joins the
             # signature memo below; the legacy single-device path
-            # retraces per regrid as the bucketing equivalence baseline)
-            self._fix_flux = jax.jit(fix_flux)
+            # retraces per regrid as the bucketing equivalence baseline
+            from cup3d_tpu.parallel.forest import bind_step_executable
+
+            self._fix_flux = bind_step_executable(fix_flux)
 
         if self.mesh is not None:
-            self._forest_memo[sig] = {
+            self._forest_memo.put(sig, {
                 k: getattr(self, k) for k in _FOREST_EXEC_ATTRS
                 if hasattr(self, k)
-            }
-            while len(self._forest_memo) > 4:
-                self._forest_memo.pop(next(iter(self._forest_memo)))
+            })
 
     # -- capacity-bucketed rebuild (the single-device production path) -----
 
@@ -1173,16 +1171,14 @@ class AMRSimulation:
             names the caller-facing state argnums (vel/p) the megastep
             rebinds from its outputs (JX002 burn-down)."""
             if self.forest is not None:
-                jits = [
-                    # jax-lint: allow(JX007, forest path traces once per
-                    # NEW octree signature and rides _forest_memo after
-                    # (see _rebuild jit_bound); the bucketed path caches
-                    # via _build_megastep_bucketed)
-                    jax.jit(lambda *a, _so=so: fn(*a, *tabs,
-                                                  second_order=_so),
-                            donate_argnums=donate)
-                    for so in (False, True)
-                ]
+                # jit construction delegated to parallel/forest.py
+                # (bind_order_executables): once per NEW signature, then
+                # _forest_memo — the JX007 burn-down, as in jit_bound
+                from cup3d_tpu.parallel.forest import (
+                    bind_order_executables,
+                )
+
+                jits = bind_order_executables(fn, tabs, donate=donate)
                 return lambda *a: jits[
                     self.step_idx >= self.cfg.step_2nd_start
                 ](*a)
@@ -2123,6 +2119,9 @@ class AMRSimulation:
                     # jax-lint: allow(JX010, ob.position is the host
                     # numpy mirror — a host-side copy for the window
                     # table math, no device value crosses here)
+                    # jax-lint: allow(JX016, same: host numpy mirror in,
+                    # host table math out — nothing shard-resident is
+                    # gathered)
                     self.grid, np.asarray(ob.position), ob.length
                 )
                 # jax-lint: allow(JX004, the window slot tables are host-

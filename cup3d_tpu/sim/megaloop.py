@@ -48,7 +48,7 @@ from cup3d_tpu.models.base import (
     quat_to_rot_dev,
     rigid_update_device,
 )
-from cup3d_tpu.ops.advection import rk3_step
+from cup3d_tpu.ops.advection import GHOSTS, rk3_step
 from cup3d_tpu.ops.chi import towers_chi
 from cup3d_tpu.ops.diagnostics import max_velocity
 from cup3d_tpu.ops.penalization import (
@@ -322,3 +322,272 @@ def build_fish_megaloop(s, ob):
             lambda c, x: one_step(gait, c, x), carry, cfl_eff)
 
     return jax.jit(megaloop, donate_argnums=(0,))
+
+
+# -- x-slab sharded megaloop (round 18) ---------------------------------
+#
+# The whole K-step scan body runs under shard_map on the topology
+# layer's "x" axis: advection-diffusion consumes ring-halo-padded slabs
+# (parallel/ring.pad_slab_vector — the two boundary messages per
+# component are issued BEFORE the interior stencil, async remote copies
+# on TPU), while the global phases (the spectral Poisson solve, the
+# body integrals, the force probe) compute REPLICATED on
+# ``lax.all_gather(..., tiled=True)`` results.  Replication instead of
+# host staging keeps the collective on-device (the JX016 line) and buys
+# bitwise equivalence with the solo megaloop for free: every sharded
+# element sees the identical arithmetic, max-reductions cross shards
+# through ``pmax`` (fp max is exactly associative), and sum-reductions
+# run on full gathered arrays in the solo reduction order.
+
+
+def _slab_specs(keys, axis):
+    """shard_map carry specs: field leaves (vel/p/chi/udef) slab-shard
+    dim 0 over ``axis``; the scalar chain replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    from cup3d_tpu.parallel.topology import FIELD_KEYS
+
+    return {k: (P(axis) if k in FIELD_KEYS else P()) for k in keys}
+
+
+def make_tgv_step_sharded(s, axis="x"):
+    """The obstacle-free scan body on one x-slab, to run INSIDE
+    shard_map over mesh axis ``axis``.  Same carry keys and row layout
+    as make_tgv_step; vel/p arrive as the local (nx/D, ny, nz[, 3])
+    slabs.  RK3 and the divergence read ring-padded slabs; the Poisson
+    solve runs replicated on the gathered rhs and each shard slices its
+    own pressure slab (and its sx+2 gradient window) back out."""
+    from cup3d_tpu.ops import stencils as st
+    from cup3d_tpu.parallel import ring as _ring
+
+    grid, nu, dtype = s.grid, s.nu, s.dtype
+    h = float(grid.h)
+    solver = s.poisson_solver
+    uinf = s.uinf_device()
+
+    def pad_vec(u, w):
+        return _ring.pad_slab_vector(grid, u, w, axis)
+
+    def one_step(carry, cfl_eff):
+        vel, p = carry["vel"], carry["p"]
+        umax, time, dtprev = carry["umax"], carry["time"], carry["dt"]
+        cap = (h * h / 6.0) / (nu + (h / 6.0) * umax)
+        dt = jnp.minimum(cfl_eff * h / (umax + 1e-8), cap)
+        dt = jnp.where(dtprev > 0, jnp.minimum(dt, 1.03 * dtprev), dt)
+        vel = rk3_step(grid, vel, dt, nu, uinf, pad=pad_vec)
+        # projection: slab divergence, replicated global solve
+        # (ops/projection.pressure_rhs semantics on the slab)
+        rhs_l = st.divergence(pad_vec(vel, 1), 1, grid.h) / dt
+        rhs = jax.lax.all_gather(rhs_l, axis, axis=0, tiled=True)
+        p_full = solver(
+            rhs, jax.lax.all_gather(p, axis, axis=0, tiled=True))
+        sx = vel.shape[0]
+        me = jax.lax.axis_index(axis)
+        p_new = jax.lax.dynamic_slice_in_dim(p_full, me * sx, sx, axis=0)
+        win = jax.lax.dynamic_slice_in_dim(
+            grid.pad_scalar(p_full, 1), me * sx, sx + 2, axis=0)
+        vel = vel - dt * st.grad(win, 1, grid.h)
+        umax_new = jax.lax.pmax(max_velocity(vel, uinf), axis)
+        time_new = time + dt
+        out = {"vel": vel, "p": p_new, "umax": umax_new,
+               "time": time_new, "dt": dt}
+        row = jnp.concatenate([_solver_stats(dtype), umax_new[None],
+                               dt[None], time_new[None]])
+        return out, row
+
+    return one_step
+
+
+def build_tgv_megaloop_sharded(s, mesh, axis="x"):
+    """jitted (carry, cfl_eff (K,)) -> (carry', rows (K, TGV_ROW)) with
+    the scan body shard_mapped over the mesh's ``axis`` slabs.  Global
+    shapes in and out match the solo megaloop exactly.  Returns None
+    when unbuildable: an iterative (stats-advertising) solver keeps the
+    solo path, and a mesh axis that does not divide nx cannot slab."""
+    import warnings
+
+    from jax.sharding import PartitionSpec as P
+
+    from cup3d_tpu.obs import metrics as M
+    from cup3d_tpu.parallel import topology as topo
+    from cup3d_tpu.parallel.compat import shard_map
+
+    if getattr(s.poisson_solver, "supports_stats", False):
+        return None
+    D = topo.mesh_axis_size(mesh, axis)
+    if s.grid.shape[0] % D or s.grid.shape[0] // D < GHOSTS:
+        warnings.warn(
+            f"{D} x-shards cannot slab nx={s.grid.shape[0]} (need even "
+            f"slabs of >= {GHOSTS} planes for the one-hop ring halo): "
+            f"megaloop runs unsharded", stacklevel=2)
+        M.counter("topology.megaloop_mesh_fallbacks").inc()
+        return None
+    one_step = make_tgv_step_sharded(s, axis)
+
+    def megaloop(carry, cfl_eff):
+        return jax.lax.scan(one_step, carry, cfl_eff)
+
+    specs = _slab_specs(("vel", "p", "umax", "time", "dt"), axis)
+    sm = shard_map(megaloop, mesh, in_specs=(specs, P()),
+                   out_specs=(specs, P()))
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+def make_fish_step_sharded(s, ob, axis="x"):
+    """The single-StefanFish scan body on one x-slab (inside shard_map
+    over ``axis``).  The stencil-heavy advection-diffusion runs sharded
+    on ring-padded slabs; the body phases (rasterization, chi, the
+    momentum integrals, penalization, projection, probe) compute
+    replicated — rasterization from replicated rigid scalars is already
+    identical everywhere, and the rest works on the gathered velocity,
+    so every reduction keeps the solo order and the step stays bitwise
+    against make_fish_step."""
+    from cup3d_tpu.models.fish.rasterize import rasterize_midline
+    from cup3d_tpu.ops.surface import (
+        _uniform_window_probe,
+        obstacle_probe_budget,
+        window_size_cells,
+    )
+    from cup3d_tpu.parallel import ring as _ring
+
+    grid, nu, dtype = s.grid, s.nu, s.dtype
+    cfg = s.cfg
+    h = float(grid.h)
+    solver = s.poisson_solver
+
+    n = np.asarray(grid.shape)
+    grid_shape = tuple(int(v) for v in n)
+    window_shape = tuple(ob._window_shape)
+    half_win = jnp.asarray(0.5 * np.asarray(window_shape) * h, dtype)
+    lim_win = jnp.asarray(n - np.asarray(window_shape), jnp.int32)
+    wp = int(min(window_size_cells(ob.length, h), n.min()))
+    half_probe = jnp.asarray(0.5 * wp * h, dtype)
+    lim_probe = jnp.asarray(n - wp, jnp.int32)
+    budget = obstacle_probe_budget(ob, h)
+    forced_mask = ob.forced_mask_dev()
+    block_mask = ob.block_mask_dev()
+    fix_frame = bool(ob.bFixFrameOfRef)
+    uinf_const = None if fix_frame else s.uinf_device()
+    xc = s.xc
+    h3 = h ** 3
+    hd = jnp.asarray(h, dtype)
+    zero3 = jnp.zeros(3, dtype)
+    dlm = float(cfg.DLM)
+    lam_static = jnp.asarray(cfg.lambda_penalization, dtype)
+
+    from cup3d_tpu.models.fish.device_midline import midline_state_device
+
+    def pad_vec(u, w):
+        return _ring.pad_slab_vector(grid, u, w, axis)
+
+    def one_step(gait, carry, cfl_eff):
+        vel, p = carry["vel"], carry["p"]
+        rigid, qint = carry["rigid"], carry["qint"]
+        umax, time, dtprev = carry["umax"], carry["time"], carry["dt"]
+        cap = (h * h / 6.0) / (nu + (h / 6.0) * umax)
+        dt = jnp.minimum(cfl_eff * h / (umax + 1e-8), cap)
+        dt = jnp.where(dtprev > 0, jnp.minimum(dt, 1.03 * dtprev), dt)
+        uinf = -rigid[0:3] if fix_frame else uinf_const
+        # shape kinematics + rasterization: replicated (pure functions
+        # of the replicated rigid/gait scalars)
+        mid, qint_new = midline_state_device(gait, time, dt, qint)
+        pos = rigid[6:9]
+        rot = quat_to_rot_dev(rigid[15:19])
+        idx0 = jnp.clip(jnp.floor((pos - half_win) / hd).astype(jnp.int32),
+                        0, lim_win)
+        origin = idx0.astype(dtype) * hd
+        sdf_w, udef_w = rasterize_midline(origin, hd, window_shape, mid,
+                                          pos, rot)
+        sdf = jnp.full(grid_shape, -1.0, dtype)
+        sdf = jax.lax.dynamic_update_slice(
+            sdf, sdf_w, (idx0[0], idx0[1], idx0[2]))
+        udef = jnp.zeros(grid_shape + (3,), dtype)
+        udef = jax.lax.dynamic_update_slice(
+            udef, udef_w, (idx0[0], idx0[1], idx0[2], 0))
+        chi = towers_chi(grid.pad_scalar(sdf, 1), grid.h)
+        udef = udef * (chi > 0)[..., None]
+        # advection-diffusion on the slab, halos by ring permute
+        vel = rk3_step(grid, vel, dt, nu, uinf, pad=pad_vec)
+        vel_full = jax.lax.all_gather(vel, axis, axis=0, tiled=True)
+        mom = pack_moments(
+            momentum_integrals_core(xc, h3, chi, vel_full, rigid[12:15]))
+        out = rigid_update_device(mom, rigid, forced_mask, block_mask,
+                                  uinf, dt)
+        rigid_new = out[:RIGID_STATE]
+        ut, om, cm = out[0:3], out[3:6], out[12:15]
+        ubody = ut + jnp.cross(jnp.broadcast_to(om, xc.shape), xc - cm) \
+            + udef
+        lam = dlm / dt if dlm > 0 else lam_static
+        vel_pen = penalize(vel_full, chi, ubody, lam, dt)
+        PF = -per_obstacle_penalization_force(
+            vel_pen, vel_full, (chi,), dt, h3, xc, cm[None])[0]
+        p_prev = jax.lax.all_gather(p, axis, axis=0, tiled=True)
+        vel_proj, p_full = project(grid, vel_pen, dt, solver, chi, udef,
+                                   p_init=p_prev)
+        stats = _solver_stats(dtype)
+        idx0f = jnp.clip(
+            jnp.floor((out[6:9] - half_probe) / hd).astype(jnp.int32),
+            0, lim_probe)
+        F = pack_forces(_uniform_window_probe(
+            vel_proj, p_full, chi, sdf, udef, idx0f, hd, zero3, nu, cm,
+            ut, om, wcells=wp, max_points=budget))
+        umax_new = jnp.maximum(max_velocity(vel_proj, uinf),
+                               jnp.max(jnp.abs(udef)))
+        time_new = time + dt
+        sx = vel.shape[0]
+        me = jax.lax.axis_index(axis)
+
+        def sl(a):
+            return jax.lax.dynamic_slice_in_dim(a, me * sx, sx, axis=0)
+
+        carry_new = {
+            "vel": sl(vel_proj), "p": sl(p_full), "chi": sl(chi),
+            "udef": sl(udef), "rigid": rigid_new, "qint": qint_new,
+            "umax": umax_new, "time": time_new, "dt": dt,
+        }
+        row = jnp.concatenate([out, PF, F, stats, qint_new,
+                               umax_new[None], dt[None], time_new[None]])
+        return carry_new, row
+
+    return one_step
+
+
+def build_fish_megaloop_sharded(s, ob, mesh, axis="x"):
+    """jitted (carry, cfl_eff (K,)) -> (carry', rows (K, FISH_ROW)) with
+    the fish scan body shard_mapped over ``axis`` slabs.  Returns None
+    when the gait is not freezable, the solver advertises stats (the
+    iterative front-ends keep the solo path — their [residual, iter]
+    telemetry has no replicated form yet), or nx does not slab."""
+    import warnings
+
+    from jax.sharding import PartitionSpec as P
+
+    from cup3d_tpu.models.fish.device_midline import freeze_gait
+    from cup3d_tpu.obs import metrics as M
+    from cup3d_tpu.parallel import topology as topo
+    from cup3d_tpu.parallel.compat import shard_map
+
+    gait = freeze_gait(ob, s.time, s.dtype)
+    if gait is None:
+        return None
+    if getattr(s.poisson_solver, "supports_stats", False):
+        return None
+    D = topo.mesh_axis_size(mesh, axis)
+    if s.grid.shape[0] % D or s.grid.shape[0] // D < GHOSTS:
+        warnings.warn(
+            f"{D} x-shards cannot slab nx={s.grid.shape[0]} (need even "
+            f"slabs of >= {GHOSTS} planes for the one-hop ring halo): "
+            f"megaloop runs unsharded", stacklevel=2)
+        M.counter("topology.megaloop_mesh_fallbacks").inc()
+        return None
+    one_step = make_fish_step_sharded(s, ob, axis)
+
+    def megaloop(carry, cfl_eff):
+        return jax.lax.scan(
+            lambda c, x: one_step(gait, c, x), carry, cfl_eff)
+
+    specs = _slab_specs(("vel", "p", "chi", "udef", "rigid", "qint",
+                         "umax", "time", "dt"), axis)
+    sm = shard_map(megaloop, mesh, in_specs=(specs, P()),
+                   out_specs=(specs, P()))
+    return jax.jit(sm, donate_argnums=(0,))

@@ -88,6 +88,7 @@ class Simulation:
         self._scan_k = 0
         self._megaloop = None  # (jitted scan fn, row width) once built
         self._scan_carry = None  # device carry dict between megaloops
+        self._scan_mesh = None  # round-18 x-slab mesh when sharded
 
     # -- setup (reference init(), main.cpp:15163-15178) --------------------
 
@@ -161,13 +162,29 @@ class Simulation:
                 and self._resilience.dt_scale != 1.0):
             return False
         if self._megaloop is None:
+            from cup3d_tpu.parallel import topology as topo
             from cup3d_tpu.sim import megaloop as ml
 
+            # CUP3D_MESH_X asks for the x-slab sharded scan body
+            # (round 18); builders return None when the run cannot
+            # slab (solver stats, nx % D, thin slabs) and the solo
+            # loop stays the loud fallback
+            mesh = topo.megaloop_mesh()
+            fn = None
             if s.obstacles:
-                fn = ml.build_fish_megaloop(s, s.obstacles[0])
+                if mesh is not None:
+                    fn = ml.build_fish_megaloop_sharded(
+                        s, s.obstacles[0], mesh)
+                self._scan_mesh = mesh if fn is not None else None
+                if fn is None:
+                    fn = ml.build_fish_megaloop(s, s.obstacles[0])
                 row_w = ml.FISH_ROW
             else:
-                fn = ml.build_tgv_megaloop(s)
+                if mesh is not None:
+                    fn = ml.build_tgv_megaloop_sharded(s, mesh)
+                self._scan_mesh = mesh if fn is not None else None
+                if fn is None:
+                    fn = ml.build_tgv_megaloop(s)
                 row_w = ml.TGV_ROW
             if fn is None:
                 # gait not freezable after all: scan off for the run
@@ -480,8 +497,15 @@ class Simulation:
                     self._scan_carry = (
                         ml.init_fish_carry(s, s.obstacles[0])
                         if s.obstacles else ml.init_tgv_carry(s))
+                    if self._scan_mesh is not None:
+                        from cup3d_tpu.parallel import topology as topo
+
+                        self._scan_carry = topo.shard_carry(
+                            self._scan_carry, self._scan_mesh)
             # the CFL ramp is a pure function of the step index: host
             # precompute, shipped once per megaloop
+            # jax-lint: allow(JX016, host list of Python floats in, host
+            # ndarray out — no shard-resident array is gathered)
             cfl = np.asarray([
                 dtpolicy.ramped_cfl(cfg.CFL, base_step + k, cfg.rampup)
                 for k in range(K)

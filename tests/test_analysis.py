@@ -658,6 +658,89 @@ def test_jx015_construction_and_upload_paths_are_clean():
     assert not any(v.rule == "JX015" for v in _failing(bare, FLEET))
 
 
+def test_jx016_sharded_materialization_fires_suppresses_and_scopes():
+    """Full-array materialization in a sharded step path (round 18):
+    device_get / np.asarray / bare single-arg device_put inside a
+    step/advance/dispatch/megaloop function of sim|fleet|parallel is a
+    cross-shard gather under the 2-D mesh."""
+    PAR = "cup3d_tpu/parallel/fixture.py"
+    src = (
+        "import jax\n"
+        "class Driver:\n"
+        "    def advance_megaloop(self):\n"
+        "        rows = jax.device_get(self.carry['vel'])\n"
+        "        return rows\n"
+    )
+    vs = _failing(src, PAR)
+    assert _rules(vs) == {"JX016"}
+    assert "cross-shard gather" in vs[0].message
+    pull = (
+        "import numpy as np\n"
+        "class Batch:\n"
+        "    def dispatch(self):\n"
+        "        return np.asarray(self.carry['vel'])\n"
+    )
+    assert _rules(_failing(pull, "cup3d_tpu/fleet/fixture.py")) == {
+        "JX016"}
+    # single-arg device_put re-places onto the default device — a
+    # gather when the input was sharded; the explicit-sharding form
+    # is the sanctioned placement and stays clean
+    put = (
+        "import jax\n"
+        "def step(carry):\n"
+        "    return jax.device_put(carry)\n"
+    )
+    assert _rules(_failing(put, "cup3d_tpu/parallel/fixture.py")) == {
+        "JX016"}
+    placed = put.replace("jax.device_put(carry)",
+                         "jax.device_put(carry, sharding)")
+    assert not any(v.rule == "JX016"
+                   for v in _failing(placed, "cup3d_tpu/parallel/f.py"))
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "        rows = jax.device_get",
+        "        # jax-lint: allow(JX016, designed postmortem read)\n"
+        "        rows = jax.device_get",
+    )
+    all_vs = L.lint_source(ok, PAR)
+    assert not L.failing(all_vs)
+    assert any(v.rule == "JX016" and "postmortem" in
+               (v.suppression_reason or "") for v in all_vs)
+    # scoped: the same pull outside sim|fleet|parallel never fires
+    assert not any(v.rule == "JX016"
+                   for v in _failing(src, "cup3d_tpu/obs/fixture.py"))
+
+
+def test_jx016_sanctioned_and_builder_paths_are_clean():
+    """The designed sync points (sanctioned_transfer blocks) and the
+    once-per-topology builder factories (make_*/build_*) are exempt;
+    inner step closures of a builder stay covered."""
+    sanctioned = (
+        "import numpy as np\n"
+        "from cup3d_tpu.analysis.runtime import sanctioned_transfer\n"
+        "class Driver:\n"
+        "    def advance(self):\n"
+        "        with sanctioned_transfer('qoi-read'):\n"
+        "            vals = np.asarray(self.pack)\n"
+        "        return vals\n"
+    )
+    assert not any(v.rule == "JX016" for v in _failing(sanctioned, HOT))
+    builder = (
+        "import numpy as np\n"
+        "def make_tgv_step(s):\n"
+        "    h = np.asarray(s.grid.h)\n"
+        "    def step(carry, cfl):\n"
+        "        return carry\n"
+        "    return step\n"
+    )
+    assert not any(v.rule == "JX016" for v in _failing(builder, HOT))
+    leaky = builder.replace(
+        "        return carry\n",
+        "        return np.asarray(carry)\n",
+    )
+    assert any(v.rule == "JX016" for v in _failing(leaky, HOT))
+
+
 def test_jx014_wallclock_duration_fires_and_suppresses():
     """Wall-clock subtraction used as a duration (round 16): NTP slews
     and steps time.time(), so a latency computed from it can go

@@ -67,6 +67,16 @@ python -m cup3d_tpu.analysis --rules JX014 $PATHS -q
 echo "== python -m cup3d_tpu.analysis --rules JX015 cup3d_tpu/fleet"
 python -m cup3d_tpu.analysis --rules JX015 cup3d_tpu/fleet -q
 
+# the sharded-materialization rule on its own line (round 18): a
+# device_get/np.asarray (or bare single-arg device_put) in a
+# step/advance/dispatch/megaloop path of sim|fleet|parallel fails CI
+# identifiably — under the 2-D (lanes, x) mesh that is a cross-shard
+# gather; designed sync points stay inside sanctioned_transfer blocks
+echo "== python -m cup3d_tpu.analysis --rules JX016" \
+     "cup3d_tpu/sim cup3d_tpu/fleet cup3d_tpu/parallel"
+python -m cup3d_tpu.analysis --rules JX016 \
+    cup3d_tpu/sim cup3d_tpu/fleet cup3d_tpu/parallel -q
+
 # fused-kernel smoke (round 12): the interpret-mode selftest exercises
 # every Pallas stage of the fused BiCGSTAB driver without a TPU
 echo "== python -m cup3d_tpu.ops.fused_bicgstab"

@@ -6,7 +6,8 @@ Reads the append-only JSONL store ``bench.py`` writes after every run
 ``DEFAULT_SPECS`` set: ``cells_per_s``, ``bicgstab_iter_device_ms``,
 ``wall_per_step_p95_s``, ``fleet_cells_per_s``, ``amr_cells_per_s``,
 ``amr_bicgstab_iter_device_ms``, ``fleet_job_p99_s``,
-``fleet_occupancy``), compares the newest value against the
+``fleet_occupancy``, ``mesh_cells_per_s``), compares the newest value
+against the
 median of the previous N — the BENCH_r0x snapshots as a
 machine-checkable time series.
 
@@ -86,7 +87,10 @@ def selftest() -> None:
                 "fleet_slo": {"fleet_job_p99_s": 2.0 / amr_scale},
                 # round 17: lane occupancy of the continuous-batching
                 # fleet_skew config — DROPS when reseeding degrades
-                "fleet_skew": {"fleet_occupancy": 0.8 * amr_scale}}
+                "fleet_skew": {"fleet_occupancy": 0.8 * amr_scale},
+                # round 18: sharded megaloop throughput of the mesh2d
+                # scale-out config — DROPS when the slab path regresses
+                "mesh2d": {"mesh_cells_per_s": 4.0e6 * amr_scale}}
 
     with tempfile.TemporaryDirectory() as td:
         store = obs_history.HistoryStore(os.path.join(td, "hist.jsonl"))
@@ -109,7 +113,8 @@ def selftest() -> None:
         for name in ("cells_per_s", "bicgstab_iter_device_ms",
                      "wall_per_step_p95_s", "fleet_cells_per_s",
                      "amr_cells_per_s", "amr_bicgstab_iter_device_ms",
-                     "fleet_job_p99_s", "fleet_occupancy"):
+                     "fleet_job_p99_s", "fleet_occupancy",
+                     "mesh_cells_per_s"):
             assert by[name]["regressed"], (name, by[name])
         # a malformed line is skipped, not fatal
         with open(store.path, "a") as f:
