@@ -93,12 +93,22 @@ Rule summary (full rationale in ``analysis/rules.py``):
          removes.  Slice shard-locally under shard_map, place with an
          explicit ``device_put(x, sharding)``, and stage host reads
          through the designed sync points (sanctioned_transfer).
+- JX017  hand-typed hardware peak/bandwidth literal in a roofline or
+         bench reporting path: a numeric constant >= 1e9 that is not an
+         exact power of ten (``197e12``, ``819e9``) hard-codes one
+         device's spec sheet into MFU/HBM math that runs on EVERY
+         backend — the round-19 bug class where rooflines silently lie
+         on non-v5e hardware.  Peaks live in the ``obs/costs.py``
+         device-kind table (the one exempt module); consumers call
+         ``device_peaks()``.  Scope: ``bench*.py`` files plus any
+         function named like roofline/peak-model in the package.
 """
 
 from __future__ import annotations
 
 import ast
 import json
+import math
 import os
 import re
 from dataclasses import dataclass, field
@@ -221,6 +231,28 @@ JX016_BUILDER_RE = re.compile(r"^(make_|build_|bind_|_build_)")
 #: jax's default device (a cross-shard gather when the input was
 #: sharded); device_put WITH an explicit sharding argument stays legal
 JX016_HOST_PULLS = frozenset({"device_get", "asarray", "array"})
+
+#: JX017 scope: the bench entrypoints (any bench*.py) and, anywhere in
+#: the tree, functions whose names say they place work on a roofline
+#: or model a hardware ceiling
+JX017_PATH_RE = re.compile(r"(^|/)bench[^/]*\.py$")
+JX017_FUNC_RE = re.compile(r"roofline|peak", re.IGNORECASE)
+
+#: the one sanctioned home for hardware peak literals: the device-kind
+#: table in obs/costs.py (provenance-annotated, nominal-flagged)
+JX017_EXEMPT_RE = re.compile(r"cup3d_tpu/obs/costs\.py$")
+
+#: spec-sheet magnitudes start at ~1e9 (GB/s bandwidths); exact powers
+#: of ten below/at any magnitude are unit conversions (1e9, 1e12), not
+#: hardware claims
+JX017_MIN_MAGNITUDE = 1e9
+
+
+def _is_power_of_ten(v: float) -> bool:
+    if v <= 0:
+        return False
+    e = round(math.log10(v))
+    return abs(v - 10.0 ** e) <= 1e-6 * (10.0 ** e)
 
 
 def _is_host_metadata(expr: ast.AST) -> bool:
@@ -491,6 +523,11 @@ class FileLint:
                 self._check_batch_reassembly(func, qualname)  # JX015
             if JX016_MODULE_RE.search(self.path):
                 self._check_sharded_materialization(func, qualname)  # JX016
+            if not JX017_EXEMPT_RE.search(self.path) and (
+                JX017_PATH_RE.search(self.path)
+                or JX017_FUNC_RE.search(func.name)
+            ):
+                self._check_hardware_peaks(func, qualname)  # JX017
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         self._check_wallclock_duration(self.tree, "<module>")  # JX014
@@ -499,6 +536,10 @@ class FileLint:
             self._check_bf16_reduction(self.tree, "<module>")  # JX011
         if JX013_MODULE_RE.search(self.path):
             self._check_lane_device_loop(self.tree, "<module>")  # JX013
+        if JX017_PATH_RE.search(self.path) and not JX017_EXEMPT_RE.search(
+            self.path
+        ):
+            self._check_hardware_peaks(self.tree, "<module>")  # JX017
         return self.violations
 
     # -- plumbing ----------------------------------------------------------
@@ -1328,6 +1369,38 @@ class FileLint:
                 "cross-shard gather under the 2-D mesh; slice shard-"
                 "locally under shard_map or place with an explicit "
                 "`device_put(x, sharding)`",
+            )
+
+    # -- JX017 -------------------------------------------------------------
+
+    def _check_hardware_peaks(self, func: ast.AST, qualname: str) -> None:
+        """Hand-typed hardware peak/bandwidth literal in a roofline or
+        bench reporting path (JX017).  A numeric constant >= 1e9 that
+        is not an exact power of ten reads like a spec sheet
+        (``197e12`` FLOP/s, ``819e9`` B/s) and bakes ONE device kind
+        into math that runs on every backend — MFU and HBM fractions
+        then silently lie on other hardware.  Exact powers of ten are
+        unit conversions (``1e9`` for GB, ``1e12`` for T) and stay
+        legal.  The sanctioned home for the literals is the
+        provenance-annotated device-kind table in ``obs/costs.py``
+        (path-exempt); consumers resolve the LIVE device through
+        ``obs.costs.device_peaks()``."""
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Constant):
+                continue
+            v = node.value
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            v = float(v)
+            if v < JX017_MIN_MAGNITUDE or _is_power_of_ten(v):
+                continue
+            self._emit(
+                "JX017", node, qualname,
+                f"numeric literal {node.value!r} in a roofline/bench "
+                "path looks like a hand-typed hardware peak — resolve "
+                "the live device via obs.costs.device_peaks() (the "
+                "obs/costs.py table is the one sanctioned home for "
+                "spec-sheet numbers)",
             )
 
     # -- JX009 -------------------------------------------------------------

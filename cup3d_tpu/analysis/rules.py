@@ -237,6 +237,23 @@ RULES: Dict[str, Rule] = {
             "(obs.profile.CONTROLLER / CaptureController.capture()) and "
             "obs spans under CUP3D_TRACE_XLA=1 instead.",
         ),
+        Rule(
+            "JX017",
+            "hand-typed hardware peak literal in a roofline/bench path",
+            "A numeric constant >= 1e9 that is not an exact power of "
+            "ten inside a bench*.py file or a roofline/peak-model "
+            "function reads like a spec sheet (197e12 bf16 FLOP/s, "
+            "819e9 HBM B/s) and hard-codes ONE device kind into math "
+            "that runs on EVERY backend: the reported MFU and HBM "
+            "fractions then silently lie on anything that is not that "
+            "device — the round-19 bug class where bench.py divided by "
+            "v5e ceilings regardless of hardware.  Hardware peaks live "
+            "in the provenance-annotated device-kind table in "
+            "obs/costs.py (the one path-exempt module, nominal-flagged "
+            "CPU fallback included); consumers resolve the live "
+            "backend with obs.costs.device_peaks().  Exact powers of "
+            "ten (1e9, 1e12) are unit conversions and never fire.",
+        ),
     )
 }
 

@@ -83,6 +83,7 @@ from cup3d_tpu.config import SimulationConfig
 from cup3d_tpu.fleet import batch as FB
 from cup3d_tpu.fleet import isolate as ISO
 from cup3d_tpu.grid.bucket import count_capacity
+from cup3d_tpu.obs import federate as FEDERATE
 from cup3d_tpu.obs import flight as _flight
 from cup3d_tpu.obs import metrics as M
 from cup3d_tpu.obs import trace as OT
@@ -567,6 +568,16 @@ class FleetBatch:
                           shard=str(shard)).inc(sb)
                 M.counter("fleet.shard_total_lane_steps",
                           shard=str(shard)).inc(bl * self.K)
+        # round-19 observatory seam: per-shard K-boundary walls + skew
+        # detection + the federation snapshot refresh.  Host scalars
+        # only (the mark is obs.trace.now()); both calls collapse to
+        # one bool/len test when nothing is armed or the batch is
+        # unsharded, so the solo-lane hot path pays nothing.
+        if ns > 1:
+            FEDERATE.STRAGGLER.boundary(
+                range(ns), source="fleet", sink=OT.TRACE,
+                step=int(self.dispatches))
+        FEDERATE.FED.on_k_boundary()
         if self._since_snap >= self.snap_dispatches:
             self.settle()
             self.guard.snapshot(self.carry, self.step_h, self.left_h)

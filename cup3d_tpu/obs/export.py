@@ -247,6 +247,8 @@ def health_payload() -> dict:
     from cup3d_tpu.fleet.server import live_servers as _fleet_live
 
     fleet = [srv.health() for srv in _fleet_live()]
+    from cup3d_tpu.obs import federate as _federate
+
     return {
         "status": "ok",
         "time": time.time(),
@@ -258,6 +260,8 @@ def health_payload() -> dict:
                   "steps_dropped": _trace.TRACE.steps_dropped},
         "profile": {"windows": _profile.CONTROLLER.windows,
                     "capturing": _profile.CONTROLLER.capturing},
+        "federation": _federate.FED.state(),
+        "stragglers": _federate.STRAGGLER.health(),
     }
 
 
@@ -277,8 +281,29 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/health":
                 body = json.dumps(health_payload()).encode()
                 ctype = "application/json"
+            elif path == "/federate":
+                # this process's registry snapshot, JSON — what a
+                # federation coordinator scrapes off every peer
+                from cup3d_tpu.obs import federate as _federate
+
+                body = json.dumps(_federate.FED.local_payload()).encode()
+                ctype = "application/json"
+            elif path == "/metrics/federated":
+                # the coordinator's merged view: counters summed,
+                # gauges/histograms per process labeled process=i
+                from cup3d_tpu.obs import federate as _federate
+
+                body = _federate.FED.view().render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/health/federated":
+                from cup3d_tpu.obs import federate as _federate
+
+                body = json.dumps(_federate.FED.view().health()).encode()
+                ctype = "application/json"
             else:
-                self.send_error(404, "try /metrics or /health")
+                self.send_error(
+                    404, "try /metrics[,/federated], /health[,/federated]"
+                    " or /federate")
                 return
         except Exception:
             _metrics.counter("export.errors").inc()

@@ -1,0 +1,575 @@
+"""Cross-process metric federation + mesh straggler watch (ISSUE 15,
+round 19).
+
+PR 14 made the system multi-process; the observability stack was still
+per-process — on a pod the operator saw 1/N of the fleet and no
+cross-shard skew signal.  This module is the missing layer, in two
+halves:
+
+**Federation.**  Each process serializes its registry
+(:func:`local_snapshot`) at K-boundaries (``Federation.on_k_boundary``,
+called from the megaloop/fleet dispatch seams when armed) and on
+scrape (the ``/federate`` JSON endpoint obs/export.py serves).  The
+coordinator (process 0) collects every process's snapshot —
+in-process providers first (the socket-free single-host path tests
+use), then HTTP peers listed in ``CUP3D_FEDERATE`` (it scrapes each
+peer exporter's ``/federate``) — and merges them
+(:func:`merge_snapshots`):
+
+- **counters** sum across processes (process-wide totals become
+  fleet-wide totals);
+- **gauges** keep per-process identity, re-labeled ``process=i`` (a
+  queue depth is not summable);
+- **histograms** are revived bucket-wise per process, so
+  ``metrics.merged_quantile`` over the group is EXACTLY the quantile a
+  single fleet-wide registry would have produced (same bucket counts,
+  min-of-mins, max-of-maxes) — the federated p99 is exact by
+  construction, and the test asserts equality, not approximation.
+
+The merged view renders through the existing Prometheus exposition
+(``/metrics/federated``: per-process histogram/gauge families labeled
+``process=i``, counters summed) and a federated ``/health`` with
+per-process sub-blocks and the coordinator's ``mesh_state()``.
+
+**Straggler watch.**  :class:`StragglerWatch` records per-shard
+K-boundary wall-time gauges (``fleet.shard_last_k_wall_s{shard=}``),
+computes the skew ratio slowest/median (``fleet.shard_skew_ratio``),
+bumps ``fleet.stragglers{shard=}`` when a shard exceeds
+``CUP3D_STRAGGLER_RATIO`` x median (default 2.0), emits
+``kind="shard"`` aux records + pid-4 Perfetto spans when a trace sink
+is armed, and exposes :meth:`StragglerWatch.warnings` as the
+early-warning signal ``resilience/elastic.py`` surfaces before a shard
+is actually lost.  All timestamps come from :func:`obs.trace.now` —
+the one sanctioned monotonic clock (JX008/JX014).
+
+Hot-path rule (PR 9): everything here is host dict/list arithmetic on
+scalars the callers already had.  No jax import at module scope, no
+device reads anywhere; the armed-idle path is transfer-guard clean and
+trace-free (tested with RecompileCounter budget 1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from statistics import median
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from cup3d_tpu.obs import metrics as _metrics
+from cup3d_tpu.obs import trace as _trace
+
+SNAPSHOT_SCHEMA = 1
+
+#: default alert threshold: a shard whose last-K wall exceeds this
+#: multiple of the median shard wall is flagged a straggler
+DEFAULT_STRAGGLER_RATIO = 2.0
+
+
+def straggler_ratio() -> float:
+    """``CUP3D_STRAGGLER_RATIO`` (>1.0) or the default."""
+    raw = os.environ.get("CUP3D_STRAGGLER_RATIO", "").strip()
+    if not raw:
+        return DEFAULT_STRAGGLER_RATIO
+    try:
+        r = float(raw)
+    except ValueError:
+        _metrics.counter("federate.bad_knob",
+                         knob="CUP3D_STRAGGLER_RATIO").inc()
+        return DEFAULT_STRAGGLER_RATIO
+    if r > 1.0:
+        return r
+    _metrics.counter("federate.bad_knob",
+                     knob="CUP3D_STRAGGLER_RATIO").inc()
+    return DEFAULT_STRAGGLER_RATIO
+
+
+def _dist_probe() -> dict:
+    """``parallel.topology.dist_state()`` when importable (it pulls in
+    jax); a rank-0 single-process stub otherwise — federation must work
+    in import-light/obs-only contexts."""
+    try:
+        from cup3d_tpu.parallel import topology as topo
+
+        return topo.dist_state()
+    except Exception:
+        _metrics.counter("federate.dist_probe_errors").inc()
+        return {"mode": "off", "initialized": False, "error": None,
+                "processes": 1, "rank": 0}
+
+
+def mesh_summary() -> dict:
+    """JSON-able mesh picture for federated /health and flight
+    postmortems: the distributed-init state plus every live fleet
+    server's ``mesh_state()``.  Best-effort: probes are guarded and
+    counted, a dead subsystem yields an empty block, never a raise."""
+    out: dict = {"dist": _dist_probe(), "fleet_meshes": []}
+    try:
+        from cup3d_tpu.fleet.server import live_servers
+        from cup3d_tpu.parallel import topology as topo
+
+        for srv in live_servers():
+            out["fleet_meshes"].append(topo.mesh_state(srv.mesh))
+    except Exception:
+        _metrics.counter("federate.mesh_probe_errors").inc()
+    return out
+
+
+# -- snapshot / revive -------------------------------------------------------
+
+def serialize_histogram(h: _metrics.Histogram) -> dict:
+    """One histogram's full merge state: bucket counts + count/sum/
+    min/max/last.  JSON round-trips ints and IEEE doubles exactly, so
+    reviving on the coordinator loses nothing."""
+    return {"name": h.name, "labels": {k: str(v)
+                                       for k, v in h.labels.items()},
+            "count": int(h.count), "sum": float(h.sum),
+            "min": h.min, "max": h.max, "last": h.last,
+            "bucket_counts": list(h.bucket_counts)}
+
+
+def revive_histogram(d: dict,
+                     extra_labels: Optional[dict] = None
+                     ) -> _metrics.Histogram:
+    """Rebuild an (unregistered) Histogram from its serialized state,
+    optionally with extra labels (the coordinator adds ``process=i``).
+    The revived object is merge-equivalent to the original: same
+    buckets, count, sum, min, max."""
+    labels = dict(d.get("labels") or {})
+    if extra_labels:
+        labels.update(extra_labels)
+    h = _metrics.Histogram(str(d["name"]), labels)
+    h.count = int(d["count"])
+    h.sum = float(d["sum"])
+    h.min = d.get("min")
+    h.max = d.get("max")
+    h.last = d.get("last")
+    counts = list(d.get("bucket_counts") or [])
+    if len(counts) == len(h.bucket_counts):
+        h.bucket_counts = [int(c) for c in counts]
+    else:
+        _metrics.counter("federate.bucket_mismatch").inc()
+    return h
+
+
+def local_snapshot(registry: Optional[_metrics.MetricsRegistry] = None,
+                   process: Optional[int] = None) -> dict:
+    """This process's registry, serialized for federation.
+
+    Structured per kind (counters/gauges/histograms) so the
+    coordinator can apply per-kind merge semantics; collector output
+    (stream stats etc., flat-only, counter-like) rides in ``extras``
+    and merges by summing.  ``process`` defaults to the distributed
+    rank (0 single-process)."""
+    reg = registry if registry is not None else _metrics.REGISTRY
+    dist = _dist_probe()
+    if process is None:
+        process = int(dist.get("rank") or 0)
+    counters, gauges, hists = [], [], []
+    structured_keys = set()
+    for m in reg.metrics():
+        if isinstance(m, _metrics.Histogram):
+            hists.append(serialize_histogram(m))
+            structured_keys.update(m.sample().keys())
+        elif isinstance(m, _metrics.Counter):
+            counters.append({"name": m.name, "labels": dict(m.labels),
+                             "value": m.value})
+            structured_keys.add(m.flat)
+        elif isinstance(m, _metrics.Gauge):
+            gauges.append({"name": m.name, "labels": dict(m.labels),
+                           "value": m.value})
+            structured_keys.add(m.flat)
+    extras = {k: v for k, v in reg.snapshot().items()
+              if k not in structured_keys
+              and isinstance(v, (int, float))}
+    return {"schema": SNAPSHOT_SCHEMA, "process": int(process),
+            "time": _trace.now(), "dist": dist,
+            "counters": counters, "gauges": gauges,
+            "histograms": hists, "extras": extras,
+            "shard_walls": STRAGGLER.last_walls_jsonable()}
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class FederatedView:
+    """The coordinator's merged picture over N process snapshots.
+
+    - ``counters``: flat name -> fleet-wide sum (extras folded in)
+    - ``gauges``: flat name WITH ``process=i`` label -> value
+    - ``histograms``: every per-process revived Histogram, labeled
+      ``process=i`` (what ``/metrics/federated`` renders)
+    - ``merged(name, **labels)``: the per-process group for one family
+      / label set — feed it to ``metrics.merged_quantile``
+    """
+
+    def __init__(self, snapshots: Sequence[dict]):
+        self.snapshots = sorted(
+            (s for s in snapshots if isinstance(s, dict)),
+            key=lambda s: int(s.get("process") or 0))
+        self.processes = [int(s.get("process") or 0)
+                          for s in self.snapshots]
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.histograms: List[_metrics.Histogram] = []
+        self._groups: Dict[Tuple[str, Tuple], List[_metrics.Histogram]]
+        self._groups = {}
+        #: (process, shard) -> last-K wall seconds, fleet-wide
+        self.shard_walls: Dict[Tuple[int, int], float] = {}
+        for snap in self.snapshots:
+            p = int(snap.get("process") or 0)
+            for c in snap.get("counters") or []:
+                flat = _metrics.flat_name(c["name"], c.get("labels") or {})
+                self.counters[flat] = (
+                    self.counters.get(flat, 0) + c["value"])
+            for k, v in (snap.get("extras") or {}).items():
+                self.counters[k] = self.counters.get(k, 0) + v
+            for g in snap.get("gauges") or []:
+                labels = dict(g.get("labels") or {})
+                labels["process"] = str(p)
+                self.gauges[_metrics.flat_name(g["name"], labels)] = (
+                    g["value"])
+            for hd in snap.get("histograms") or []:
+                h = revive_histogram(hd, {"process": str(p)})
+                self.histograms.append(h)
+                key = (str(hd["name"]), _label_key(hd.get("labels") or {}))
+                self._groups.setdefault(key, []).append(h)
+            for shard, wall in (snap.get("shard_walls") or {}).items():
+                try:
+                    self.shard_walls[(p, int(shard))] = float(wall)
+                except (TypeError, ValueError):
+                    _metrics.counter("federate.bad_shard_wall").inc()
+
+    def merged(self, name: str, **labels) -> List[_metrics.Histogram]:
+        """The per-process histogram group for one (name, labels)."""
+        return list(self._groups.get((name, _label_key(labels)), []))
+
+    def quantile(self, name: str, q: float, **labels) -> Optional[float]:
+        """Exact fleet-wide quantile: ``merged_quantile`` over the
+        per-process group (bucket sums + min-of-mins / max-of-maxes)."""
+        return _metrics.merged_quantile(self.merged(name, **labels), q)
+
+    def skew(self, ratio: Optional[float] = None) -> dict:
+        """Fleet-wide straggler assessment over every process's
+        per-shard walls (the federated analogue of
+        ``StragglerWatch.evaluate``)."""
+        return _assess_skew(
+            {f"{p}/{s}": w for (p, s), w in self.shard_walls.items()},
+            straggler_ratio() if ratio is None else ratio)
+
+    def render_prometheus(self) -> str:
+        """Prometheus exposition of the merged view: counters summed
+        (no process label), gauges + histogram families per process
+        with ``process=i`` — so downstream ``sum by (le)`` is exact and
+        round-trips through ``obs.export.parse_histograms``."""
+        from cup3d_tpu.obs import export as _export
+
+        snap = dict(self.counters)
+        snap.update(self.gauges)
+        return _export.render_prometheus(snap, self.histograms)
+
+    def health(self) -> dict:
+        """Federated /health body: per-process sub-blocks + the
+        coordinator's mesh picture + fleet-wide skew."""
+        procs = {}
+        for snap in self.snapshots:
+            p = str(int(snap.get("process") or 0))
+            procs[p] = {"time": snap.get("time"),
+                        "dist": snap.get("dist"),
+                        "counters": len(snap.get("counters") or []),
+                        "gauges": len(snap.get("gauges") or []),
+                        "histograms": len(snap.get("histograms") or []),
+                        "shard_walls": snap.get("shard_walls") or {}}
+        return {"schema": SNAPSHOT_SCHEMA,
+                "processes": procs,
+                "coordinator": {"mesh": mesh_summary(),
+                                "stragglers": STRAGGLER.health()},
+                "skew": self.skew()}
+
+
+def merge_snapshots(snapshots: Sequence[dict]) -> FederatedView:
+    """Merge per-process snapshots into one :class:`FederatedView`."""
+    return FederatedView(snapshots)
+
+
+# -- transport ---------------------------------------------------------------
+
+def _scrape_peer(url: str, timeout: float = 2.0) -> Optional[dict]:
+    """GET one peer exporter's ``/federate`` JSON (stdlib urllib);
+    failures are counted per peer, never raised — a dead peer drops
+    out of the merged view instead of killing the scrape."""
+    import urllib.request
+
+    target = url.rstrip("/") + "/federate"
+    try:
+        with urllib.request.urlopen(target, timeout=timeout) as resp:
+            snap = json.loads(resp.read().decode("utf-8"))
+        if isinstance(snap, dict):
+            return snap
+        _metrics.counter("federate.scrape_errors", peer=url).inc()
+    except Exception:
+        _metrics.counter("federate.scrape_errors", peer=url).inc()
+    return None
+
+
+def _peers_from_env() -> List[str]:
+    """``CUP3D_FEDERATE``: ``0``/empty = off, ``1`` = armed
+    self-only, otherwise a comma-separated list of peer exporter base
+    URLs the coordinator scrapes."""
+    spec = os.environ.get("CUP3D_FEDERATE", "0").strip()
+    if spec in ("0", "", "1"):
+        return []
+    return [p.strip() for p in spec.split(",") if p.strip()]
+
+
+class Federation:
+    """One process's federation endpoint state.
+
+    Every process runs one (the module singleton :data:`FED`): it
+    caches a local snapshot at K-boundaries and serves it on scrape.
+    The coordinator additionally collects providers (in-process,
+    socket-free) and peers (HTTP) and merges.  ``armed`` is read once
+    per K-boundary — one bool test when federation is off."""
+
+    def __init__(self,
+                 providers: Optional[List[Callable[[], dict]]] = None,
+                 peers: Optional[List[str]] = None,
+                 registry: Optional[_metrics.MetricsRegistry] = None):
+        env = os.environ.get("CUP3D_FEDERATE", "0").strip()
+        self.providers = list(providers or [])
+        self.peers = list(peers if peers is not None
+                          else _peers_from_env())
+        self.registry = registry
+        self.armed = bool(self.providers or self.peers
+                          or env not in ("0", ""))
+        self.boundaries = 0
+        self._cached: Optional[dict] = None
+        self._lock = threading.Lock()
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self) -> "Federation":
+        self.armed = True
+        return self
+
+    def disarm(self) -> "Federation":
+        self.armed = False
+        with self._lock:
+            self._cached = None
+        return self
+
+    def register_provider(self, fn: Callable[[], dict]) -> None:
+        """In-process fallback transport: ``fn()`` returns a snapshot
+        dict (another registry's :func:`local_snapshot`).  Single-host
+        tests federate N simulated processes this way — no sockets."""
+        self.providers.append(fn)
+        self.armed = True
+
+    # -- K-boundary hook ---------------------------------------------------
+
+    def on_k_boundary(self) -> None:
+        """Refresh the cached local snapshot (host dict work only).
+        Called from the megaloop / fleet dispatch K-boundary seams;
+        no-op unless armed, so the un-federated hot path pays one bool
+        test."""
+        if not self.armed:
+            return
+        snap = local_snapshot(self.registry)
+        with self._lock:
+            self._cached = snap
+        self.boundaries += 1
+        _metrics.counter("federate.boundaries").inc()
+
+    def local_payload(self) -> dict:
+        """What ``/federate`` serves: the K-boundary cache when armed
+        and fresh, else a snapshot taken now (scrape-time fallback —
+        the ISSUE's "at K-boundaries AND on scrape")."""
+        with self._lock:
+            cached = self._cached
+        if cached is not None:
+            return cached
+        return local_snapshot(self.registry)
+
+    # -- coordinator -------------------------------------------------------
+
+    def collect(self) -> List[dict]:
+        """Local payload + every provider + every scrapeable peer."""
+        snaps = [self.local_payload()]
+        for fn in list(self.providers):
+            try:
+                snap = fn()
+                if isinstance(snap, dict):
+                    snaps.append(snap)
+                else:
+                    _metrics.counter("federate.provider_errors").inc()
+            except Exception:
+                _metrics.counter("federate.provider_errors").inc()
+        for url in self.peers:
+            snap = _scrape_peer(url)
+            if snap is not None:
+                snaps.append(snap)
+        return snaps
+
+    def view(self) -> FederatedView:
+        return merge_snapshots(self.collect())
+
+    def state(self) -> dict:
+        """Compact /health block for the plain (un-federated) payload."""
+        return {"armed": self.armed, "boundaries": self.boundaries,
+                "providers": len(self.providers),
+                "peers": list(self.peers)}
+
+
+#: the process-global federation endpoint (env-armed via CUP3D_FEDERATE)
+FED = Federation()
+
+
+# -- straggler watch ---------------------------------------------------------
+
+def _assess_skew(walls: Dict[object, float], ratio: float) -> dict:
+    """Shared skew math: slowest/median over a wall map + the over-
+    threshold keys.  Returns {"shards", "median_s", "slowest_s",
+    "skew_ratio", "threshold", "stragglers"}."""
+    vals = [w for w in walls.values() if w is not None and w >= 0]
+    out = {"shards": len(vals), "median_s": None, "slowest_s": None,
+           "skew_ratio": None, "threshold": ratio, "stragglers": []}
+    if len(vals) < 2:
+        return out
+    med = median(vals)
+    slowest = max(vals)
+    out["median_s"] = med
+    out["slowest_s"] = slowest
+    if med > 0:
+        out["skew_ratio"] = slowest / med
+        out["stragglers"] = sorted(
+            (k for k, w in walls.items()
+             if w is not None and w >= ratio * med),
+            key=str)
+    return out
+
+
+class StragglerWatch:
+    """Per-shard K-boundary wall gauges + skew-ratio alerting.
+
+    The dispatch seams call :meth:`boundary` with the local shard ids;
+    the elapsed host wall since the previous boundary (on
+    :func:`obs.trace.now`) is recorded for each — in a single process
+    all local shards share the dispatch wall (honest: the dispatch IS
+    gated on its slowest local shard), and cross-process skew emerges
+    in the federated view, where each process contributes its own
+    walls.  Tests and multi-wall callers inject per-shard walls
+    directly via :meth:`record` then :meth:`evaluate`."""
+
+    def __init__(self, ratio: Optional[float] = None):
+        self._ratio = ratio
+        self.last_walls: Dict[int, float] = {}
+        self.straggler_counts: Dict[int, int] = {}
+        self.alerts: deque = deque(maxlen=64)
+        self.skew_ratio: Optional[float] = None
+        self._mark: Optional[float] = None
+        self._warnings: List[int] = []
+
+    @property
+    def ratio(self) -> float:
+        return self._ratio if self._ratio is not None else straggler_ratio()
+
+    def reset(self) -> None:
+        self.last_walls.clear()
+        self.straggler_counts.clear()
+        self.alerts.clear()
+        self.skew_ratio = None
+        self._mark = None
+        self._warnings = []
+
+    def record(self, shard: int, wall_s: float,
+               source: str = "fleet") -> None:
+        """One shard's last-K wall (host scalar the caller already
+        had, or measured here at the boundary seam)."""
+        shard = int(shard)
+        self.last_walls[shard] = float(wall_s)
+        _metrics.gauge("fleet.shard_last_k_wall_s",
+                       shard=str(shard)).set(float(wall_s))
+        _metrics.counter("fleet.shard_boundaries",
+                         source=source).inc()
+
+    def boundary(self, shards: Sequence[int], source: str = "fleet",
+                 sink: Optional[_trace.TraceSink] = None,
+                 step: int = 0) -> Optional[dict]:
+        """K-boundary tick from a dispatch seam: stamps
+        :func:`obs.trace.now`, attributes the elapsed wall since the
+        previous boundary to every local shard, and evaluates.  The
+        first boundary only sets the mark (no wall yet)."""
+        t = _trace.now()
+        mark, self._mark = self._mark, t
+        if mark is None:
+            return None
+        wall = t - mark
+        for shard in shards:
+            self.record(shard, wall, source=source)
+        return self.evaluate(source=source, sink=sink, step=step,
+                             t0=mark, dur=wall)
+
+    def evaluate(self, source: str = "fleet",
+                 sink: Optional[_trace.TraceSink] = None,
+                 step: int = 0, t0: Optional[float] = None,
+                 dur: Optional[float] = None) -> dict:
+        """Skew over the current per-shard walls: sets the
+        ``fleet.shard_skew_ratio`` gauge, bumps
+        ``fleet.stragglers{shard=}`` + the alert ring for every shard
+        over threshold, and (when a sink is armed) emits one
+        ``kind="shard"`` aux record and pid-4 span per shard."""
+        ratio = self.ratio
+        skew = _assess_skew(self.last_walls, ratio)
+        if skew["skew_ratio"] is not None:
+            self.skew_ratio = skew["skew_ratio"]
+            _metrics.gauge("fleet.shard_skew_ratio").set(self.skew_ratio)
+        self._warnings = [int(s) for s in skew["stragglers"]]
+        for shard in self._warnings:
+            self.straggler_counts[shard] = (
+                self.straggler_counts.get(shard, 0) + 1)
+            _metrics.counter("fleet.stragglers", shard=str(shard)).inc()
+            self.alerts.append({
+                "shard": shard, "step": int(step), "source": source,
+                "wall_s": self.last_walls.get(shard),
+                "median_s": skew["median_s"],
+                "skew_ratio": self.skew_ratio, "threshold": ratio})
+        if sink is not None and sink.enabled:
+            sr = self.skew_ratio if self.skew_ratio is not None else 0.0
+            straggling = set(self._warnings)
+            for shard, wall in sorted(self.last_walls.items()):
+                sink.aux(_trace.shard_record(
+                    shard, step, wall, sr,
+                    straggler=shard in straggling, source=source))
+                span_t0 = (t0 if t0 is not None
+                           else _trace.now() - wall)
+                sink.shard_span(
+                    shard, f"K-boundary s{shard}", span_t0,
+                    dur if dur is not None else wall,
+                    args={"shard": shard, "wall_s": wall,
+                          "skew_ratio": sr, "source": source,
+                          "straggler": shard in straggling})
+        return skew
+
+    def warnings(self) -> List[int]:
+        """Shards currently over threshold — the early-warning signal
+        ``resilience/elastic.py`` reads before a shard is lost."""
+        return list(self._warnings)
+
+    def last_walls_jsonable(self) -> Dict[str, float]:
+        return {str(s): float(w) for s, w in self.last_walls.items()}
+
+    def health(self) -> dict:
+        """The /health "stragglers" block."""
+        return {"threshold": self.ratio,
+                "skew_ratio": self.skew_ratio,
+                "last_walls": self.last_walls_jsonable(),
+                "straggler_counts": {str(s): c for s, c in
+                                     self.straggler_counts.items()},
+                "warnings": list(self._warnings),
+                "alerts": list(self.alerts)[-8:]}
+
+
+#: the process-global straggler watch (dispatch seams + /health share it)
+STRAGGLER = StragglerWatch()

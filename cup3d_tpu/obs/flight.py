@@ -222,6 +222,8 @@ class FlightRecorder:
             "recovery_events": [_jsonable(e) for e in self.recovery_events],
             "job_events": [_jsonable(e) for e in self.job_events],
             "metrics": _jsonable(_metrics.snapshot()),
+            "mesh": _mesh_block(),
+            "shard_walls": _shard_block(),
         }
         os.makedirs(self.directory or ".", exist_ok=True)
         tag = at_step if at_step is not None else len(self.steps)
@@ -232,6 +234,32 @@ class FlightRecorder:
         self.dumps_written.append(path)
         self._c_dumps.inc()
         return path
+
+
+def _mesh_block() -> Dict:
+    """The postmortem's mesh picture (round 19): distributed-init state
+    + every live fleet server's ``mesh_state()``.  Guarded — a broken
+    mesh probe must not kill the dump it is trying to explain."""
+    try:
+        from cup3d_tpu.obs import federate as _federate
+
+        return _jsonable(_federate.mesh_summary())
+    except Exception as e:
+        _metrics.counter("flight.mesh_probe_errors").inc()
+        return {"probe_error": repr(e)}
+
+
+def _shard_block() -> Dict:
+    """Per-shard last-K walls + straggler alerts at dump time — a
+    shard-loss postmortem shows which shard was straggling before it
+    died."""
+    try:
+        from cup3d_tpu.obs import federate as _federate
+
+        return _jsonable(_federate.STRAGGLER.health())
+    except Exception as e:
+        _metrics.counter("flight.mesh_probe_errors").inc()
+        return {"probe_error": repr(e)}
 
 
 def load_postmortem(path: str) -> Dict:

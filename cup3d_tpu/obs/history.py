@@ -110,6 +110,18 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("detail", "mesh_cells_per_s")),
         higher_is_better=True,
     ),
+    # round 19 (distributed observatory): COMPILER-counted HBM bytes of
+    # one production BiCGSTAB iteration (xla cost_analysis via
+    # obs/costs.py, bench._compiler_per_iter).  Deterministic per
+    # (jax version, backend, config) — a rise means a compile started
+    # moving more HBM traffic, caught even when wall-clock noise hides
+    # it; lower is better
+    MetricSpec(
+        "fish_bicgstab_bytes_compiler",
+        (("fish", "roofline", "legacy", "compiler", "bytes_per_iter"),
+         ("detail", "roofline", "legacy", "compiler", "bytes_per_iter")),
+        higher_is_better=False,
+    ),
 )
 
 

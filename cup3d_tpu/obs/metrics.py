@@ -321,6 +321,14 @@ class MetricsRegistry:
 
     # -- queries -----------------------------------------------------------
 
+    def metrics(self) -> List[_Metric]:
+        """Every registered metric object, kind-tagged via ``.kind`` —
+        the federation layer (obs/federate.py) serializes these into
+        per-process snapshots, so merge semantics can differ by kind
+        (counters sum, gauges keep per-process identity, histograms
+        merge bucket-wise)."""
+        return list(self._metrics.values())
+
     def histograms(self, name: Optional[str] = None) -> List[Histogram]:
         """Every registered Histogram (optionally filtered by metric
         name across all label sets) — the exporter renders ``_bucket``

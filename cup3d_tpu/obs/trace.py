@@ -60,7 +60,19 @@ per job at its terminal transition (``fleet/server.py``):
 
 and pid-3 lane-occupancy tracks (:data:`LANE_PID`) in the Perfetto
 export: one X span per job per lane, laid out next to the pid-1 host
-spans and pid-2 device sections.  Every lifecycle timestamp is
+spans and pid-2 device sections.
+
+Round 19 adds a third aux kind — the mesh straggler-watch record, one
+per shard per evaluated K-boundary (``obs/federate.py``):
+
+    {"schema": 2, "kind": "shard", "step": int, "shard": int,
+     "wall_s": float,                 # the shard's last-K wall
+     "skew_ratio": float,             # slowest/median at evaluation
+     "straggler": bool, "source": "fleet"|"megaloop"}
+
+with matching pid-4 per-shard tracks (:data:`SHARD_PID`) in the
+Perfetto export: one X span per shard per K-boundary, so a straggling
+shard is visible as a longer bar next to the lane/device tracks.  Every lifecycle timestamp is
 :func:`now` — host ``perf_counter`` on the sink's epoch, taken only at
 lifecycle seams; nothing here reads a device value.
 
@@ -109,9 +121,20 @@ JOB_EVENTS = ("submitted", "queued", "bucketed", "reseeded", "running",
               "dispatched", "fanout", "rollback", "retire",
               "done", "failed", "cancelled")
 
+#: required keys of a kind="shard" auxiliary record (round 19 — the
+#: mesh straggler watch in obs/federate.py): one per shard per
+#: evaluated K-boundary, carrying that shard's last-K wall and the
+#: fleet-wide skew ratio it was judged against.
+SHARD_REQUIRED = {"schema": int, "step": int, "shard": int,
+                  "wall_s": float, "skew_ratio": float,
+                  "source": str}
+
 #: Perfetto pid of the per-lane job-occupancy tracks (pid 1 = host
 #: spans, pid 2 = obs.profile.DEVICE_PID device sections)
 LANE_PID = 3
+
+#: Perfetto pid of the per-shard K-boundary wall tracks (round 19)
+SHARD_PID = 4
 
 
 def now() -> float:
@@ -169,6 +192,50 @@ def _validate_job_record(rec: dict) -> List[str]:
     return problems
 
 
+def shard_record(shard: int, step: int, wall_s: float, skew_ratio: float,
+                 straggler: bool = False, source: str = "fleet",
+                 **extra) -> dict:
+    """Build one kind="shard" aux record (the sink's ``aux()`` stamps
+    the schema).  ``wall_s`` is the shard's last K-boundary wall,
+    ``skew_ratio`` the slowest/median ratio it was evaluated under."""
+    rec = {"kind": "shard", "step": int(step), "shard": int(shard),
+           "wall_s": float(wall_s), "skew_ratio": float(skew_ratio),
+           "straggler": bool(straggler), "source": str(source)}
+    rec.update(extra)
+    return rec
+
+
+def _validate_shard_record(rec: dict) -> List[str]:
+    """Schema-check one kind="shard" auxiliary record."""
+    problems = []
+    for k, typ in SHARD_REQUIRED.items():
+        if k not in rec:
+            problems.append(f"missing required key {k!r}")
+        elif typ is float:
+            if not isinstance(rec[k], (int, float)) or isinstance(
+                rec[k], bool
+            ):
+                problems.append(f"{k!r} must be numeric")
+        elif not isinstance(rec[k], typ) or isinstance(rec[k], bool):
+            problems.append(f"{k!r} must be {typ.__name__}")
+    if not problems and rec["schema"] != SCHEMA_VERSION:
+        problems.append(
+            f"schema {rec['schema']} != supported {SCHEMA_VERSION}"
+        )
+    if not problems and rec["step"] < 0:
+        problems.append("step must be >= 0")
+    if not problems and rec["shard"] < 0:
+        problems.append("shard must be >= 0")
+    if not problems and rec["wall_s"] < 0:
+        problems.append("wall_s must be >= 0")
+    if not problems and rec["skew_ratio"] < 0:
+        problems.append("skew_ratio must be >= 0")
+    straggler = rec.get("straggler")
+    if straggler is not None and not isinstance(straggler, bool):
+        problems.append("straggler must be a bool")
+    return problems
+
+
 def _validate_device_record(rec: dict) -> List[str]:
     """Schema-check one kind="device" auxiliary record."""
     problems = []
@@ -208,7 +275,7 @@ def validate_step_record(rec: dict) -> List[str]:
     = valid).  Shared by the sink (debug), tests, and trace_check.
     Dispatches on the v2 ``kind`` tag: absent/"step" is a step record,
     "device" a capture-window attribution record, "job" a fleet
-    job-lifecycle record."""
+    job-lifecycle record, "shard" a mesh straggler-watch record."""
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not dict"]
     kind = rec.get("kind", "step")
@@ -216,6 +283,8 @@ def validate_step_record(rec: dict) -> List[str]:
         return _validate_device_record(rec)
     if kind == "job":
         return _validate_job_record(rec)
+    if kind == "shard":
+        return _validate_shard_record(rec)
     if kind != "step":
         return [f"unknown record kind {kind!r}"]
     problems = []
@@ -346,6 +415,7 @@ class TraceSink:
         self.steps_dropped = 0
         self._writer: Optional[_AsyncLineWriter] = None
         self._lane_meta_emitted = False
+        self._shard_meta_emitted = False
         self._lock = threading.Lock()
         # round-13 satellite: the TraceAnnotation class resolves ONCE at
         # construction/configure time, so the span hot path is a single
@@ -374,6 +444,7 @@ class TraceSink:
         self.steps_recorded = 0
         self.steps_dropped = 0
         self._lane_meta_emitted = False
+        self._shard_meta_emitted = False
         self._annotation_cls = self._resolve_annotation()
         return self
 
@@ -466,6 +537,34 @@ class TraceSink:
             "ts": (t - self.epoch) * 1e6, "s": "t",
             "args": dict(args or {}),
         })
+
+    def _ensure_shard_meta(self) -> None:
+        if not self._shard_meta_emitted:
+            self._shard_meta_emitted = True
+            self.events.append({
+                "name": "process_name", "ph": "M", "pid": SHARD_PID,
+                "ts": 0, "args": {"name": "mesh shards"},
+            })
+
+    def shard_span(self, shard: int, name: str, t0: float, dur: float,
+                   args: Optional[dict] = None) -> None:
+        """One closed per-shard K-boundary wall span on the pid-4 track
+        (``t0``/``dur`` in :func:`now` seconds).  ``shard`` is the
+        track id (one row per shard); ``args`` must carry at least the
+        ``shard`` index so tools/trace_check.py can tie the span back
+        to its straggler-watch record.  Emits the pid-4
+        ``process_name`` metadata event once per sink."""
+        if not self.enabled:
+            return
+        self._ensure_shard_meta()
+        a = dict(args or {})
+        a.setdefault("shard", int(shard))
+        self.events.append({
+            "name": name, "ph": "X", "pid": SHARD_PID, "tid": int(shard),
+            "ts": (t0 - self.epoch) * 1e6, "dur": dur * 1e6,
+            "args": a,
+        })
+        _metrics.counter("trace.shard_spans").inc()
 
     def aux(self, record: dict) -> None:
         """One kind-tagged auxiliary JSONL record interleaved with the

@@ -578,6 +578,25 @@ def legacy_bytes_model() -> float:
     return 2 * 8.0 + 2 * 8.0 + 10 * 8.0 + 4 * 4.0
 
 
+def harvest_costs(grid, b: jnp.ndarray, maxiter: int = 1,
+                  name: str = "fused_bicgstab", **kwargs):
+    """Compiler-counted cost row of one fixed-k fused-solve executable
+    (round 19): AOT lower+compile a ``maxiter``-capped fused solve on
+    ``b`` and harvest ``cost_analysis``/``memory_analysis`` through
+    obs/costs.py.  XLA counts the while body once regardless of the
+    cap, so the k=1 row IS setup + one iteration body — the compiler
+    ground truth next to :func:`bytes_model`'s analytic count.
+    Executes nothing; returns the row, or None where the backend
+    cannot lower (counted, never raised)."""
+    import jax
+
+    from cup3d_tpu.obs import costs as obs_costs
+
+    kw = dict(kwargs, tol_abs=0.0, tol_rel=0.0, maxiter=int(maxiter))
+    jitted = jax.jit(lambda bb: fused_bicgstab(grid, bb, **kw)[0])
+    return obs_costs.analyze_jitted(f"{name}_k{int(maxiter)}", jitted, b)
+
+
 def selftest() -> None:
     """Interpret-mode kernel smoke: a 16^3 Poisson solve through the
     fused driver with interpret kernels must match the jnp-twin driver.

@@ -142,7 +142,7 @@ class ExecutableMemo:
             self._memo.popitem(last=False)
 
 
-def bind_step_executable(fn, *bound, donate=()):
+def bind_step_executable(fn, *bound, donate=(), name=None):
     """One compiled step executable with the forest's (non-pytree)
     tables closed over as trailing constants: ``fn(*args, *bound)``
     jitted with ``donate`` naming the caller-facing state argnums.
@@ -151,8 +151,23 @@ def bind_step_executable(fn, *bound, donate=()):
     the adaptation path (sim/amr.py ``_rebuild``) bind here and memoize
     the result by octree signature (:class:`ExecutableMemo`), so a
     fresh jit object is only ever built once per NEW topology, never
-    per regrid pass (the JX007 hazard class this helper burns down)."""
-    return jax.jit(lambda *a: fn(*a, *bound), donate_argnums=donate)
+    per regrid pass (the JX007 hazard class this helper burns down).
+
+    Round 19: it is therefore also THE cost-accounting seam — under
+    ``CUP3D_COSTS=1`` (obs/costs.enabled) the jitted object's first
+    invocation additionally AOT-harvests the executable's compiler-
+    counted FLOPs/bytes/HBM footprint into the obs registry under
+    ``name`` (default: the wrapped fn's name).  One extra lowering per
+    bound executable, a single cached bool test per call after that —
+    the steady-state hot path is untouched."""
+    jitted = jax.jit(lambda *a: fn(*a, *bound), donate_argnums=donate)
+    from cup3d_tpu.obs import costs as obs_costs
+
+    if obs_costs.enabled():
+        label = name or getattr(fn, "__name__", None) or "forest.step"
+        jitted = obs_costs.harvest_on_first_call(
+            jitted, f"forest.{label}")
+    return jitted
 
 
 def bind_order_executables(fn, tabs, donate=()) -> tuple:
@@ -163,7 +178,9 @@ def bind_order_executables(fn, tabs, donate=()) -> tuple:
     executables, not a retrace."""
     return tuple(
         bind_step_executable(partial(fn, second_order=so), *tabs,
-                             donate=donate)
+                             donate=donate,
+                             name=f"{getattr(fn, '__name__', 'step')}"
+                                  f"_o{2 if so else 1}")
         for so in (False, True)
     )
 

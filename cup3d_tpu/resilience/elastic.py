@@ -39,7 +39,20 @@ __all__ = [
     "lanes_of_shard",
     "shard_of_lane",
     "fail_shard",
+    "straggler_warnings",
 ]
+
+
+def straggler_warnings() -> List[int]:
+    """Mesh shards currently over the straggler threshold (round 19:
+    the observatory's early-warning signal).  A slice that is straggling
+    often precedes a slice that is GONE — operators and the scheduler
+    can drain or deprioritize its lane block before ``fail_shard`` is
+    forced.  Reads the obs-side skew watch; empty when balanced or
+    unsharded."""
+    from cup3d_tpu.obs import federate as FEDERATE
+
+    return FEDERATE.STRAGGLER.warnings()
 
 
 def lanes_of_shard(n_lanes: int, nshards: int, shard: int) -> range:

@@ -529,6 +529,17 @@ class Simulation:
                     time=s.time, step=base_step, scan_k=K)
                 self._pack_reader.emit(entry)
             s.step += K
+        # round-19 observatory seam: attribute the K-boundary wall to
+        # every x-slab shard + refresh the federation snapshot.  Host
+        # scalars only (the mark is obs.trace.now()); both calls are a
+        # bool/None test when unsharded and unfederated.
+        from cup3d_tpu.obs import federate as FEDERATE
+
+        if self._scan_mesh is not None:
+            FEDERATE.STRAGGLER.boundary(
+                range(int(self._scan_mesh.devices.size)),
+                source="megaloop", sink=obs_trace.TRACE, step=base_step)
+        FEDERATE.FED.on_k_boundary()
 
     def _emit_step_pack(self) -> dict:
         """Concatenate every device QoI the step produced (rigid state,

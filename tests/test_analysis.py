@@ -741,6 +741,64 @@ def test_jx016_sanctioned_and_builder_paths_are_clean():
     assert any(v.rule == "JX016" for v in _failing(leaky, HOT))
 
 
+def test_jx017_hardware_peak_fires_suppresses_and_scopes():
+    """Hand-typed hardware peak literal in a roofline/bench path
+    (round 19): a spec-sheet constant (197e12, 819e9) in a bench*.py
+    file or a roofline/peak-named function bakes one device kind into
+    MFU/HBM math that runs on every backend."""
+    src = (
+        "def report(flops, bytes_, t):\n"
+        "    return {'mfu': flops / t / 197e12,\n"
+        "            'hbm': bytes_ / t / 819e9}\n"
+    )
+    # fires by PATH scope: any bench*.py, module and function level
+    vs = _failing(src, "bench.py")
+    assert _rules(vs) == {"JX017"} and len(vs) == 2
+    assert "device_peaks" in vs[0].message
+    # fires by FUNCTION-name scope anywhere in the package
+    fn = src.replace("def report", "def roofline_place")
+    assert _rules(_failing(fn, HOT)) == {"JX017"}
+    # out of scope: same literal in a plain function off the bench path
+    assert not any(v.rule == "JX017" for v in _failing(src, HOT))
+    # exact powers of ten are unit conversions, never hardware claims
+    units = (
+        "def roofline_place(flops, t):\n"
+        "    return {'gflops': flops / t / 1e9,\n"
+        "            'tflops': flops / t / 1e12}\n"
+    )
+    assert not any(v.rule == "JX017" for v in _failing(units, HOT))
+    # the sanctioned home: obs/costs.py is path-exempt even for
+    # peak-named functions
+    assert not any(v.rule == "JX017"
+                   for v in _failing(fn, "cup3d_tpu/obs/costs.py"))
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "    return {'mfu': flops / t / 197e12,\n"
+        "            'hbm': bytes_ / t / 819e9}\n",
+        "    # jax-lint: allow(JX017, documented reference ceiling)\n"
+        "    return {'mfu': flops / t / 197e12,\n"
+        "            'hbm': bytes_ / t / 819e9}\n",
+    )
+    all_vs = L.lint_source(ok, "bench.py")
+    fails = [v for v in L.failing(all_vs) if v.rule == "JX017"]
+    # the allow-comment binds to its line: the first literal's line is
+    # annotated, the second still fails — both behaviors on record
+    assert len(fails) == 1 and any(
+        v.rule == "JX017" and v.suppressed for v in all_vs)
+
+
+def test_jx017_in_tree_roofline_paths_are_clean():
+    """The burn-down stays burned down: bench.py and the obs/tools
+    trees carry no unannotated hardware-peak literal (the peak table in
+    obs/costs.py is path-exempt by design)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cup3d_tpu.analysis", "--rules", "JX017",
+         "bench.py", "cup3d_tpu/", "tools/", "-q"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
 def test_jx014_wallclock_duration_fires_and_suppresses():
     """Wall-clock subtraction used as a duration (round 16): NTP slews
     and steps time.time(), so a latency computed from it can go

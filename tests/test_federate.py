@@ -1,0 +1,267 @@
+"""Distributed observatory (obs/federate.py + obs/costs.py, round 19).
+
+The six pinned behaviors of the cross-process federation layer:
+
+- merged-histogram quantiles over N simulated process snapshots are
+  EXACTLY ``metrics.merged_quantile`` — and exactly what one fleet-wide
+  registry would have produced (equality, not approximation);
+- counter/gauge merge semantics: counters sum fleet-wide, gauges keep
+  per-process identity under a ``process=i`` label;
+- a federated ``/metrics`` scrape off a live exporter round-trips
+  through ``parse_prometheus_text`` / ``parse_histograms`` with the
+  process label intact;
+- the straggler watch alerts on an injected slow shard and stays quiet
+  when shards are balanced, and the gauge/alert surface round-trips
+  through a live ``/metrics`` scrape;
+- the XLA cost harvest returns sane flops/bytes for the fused-BiCGSTAB
+  executable (compiler-counted, nothing executed);
+- the armed-idle federation path is transfer-guard clean and holds the
+  steady-state retrace budget (the PR 9 zero-device-sync rule).
+"""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cup3d_tpu.obs import export as E
+from cup3d_tpu.obs import federate as FD
+from cup3d_tpu.obs import metrics as M
+
+
+def _proc_snapshot(process, values, jobs_done=1.0, queue_depth=None):
+    """One simulated process: a private registry with a latency
+    histogram, a fleet-total counter, and a per-process gauge."""
+    reg = M.MetricsRegistry()
+    h = reg.histogram("fleet.job_e2e_s", tenant="acme")
+    for v in values:
+        h.observe(float(v))
+    reg.counter("fleet.jobs_done").inc(jobs_done)
+    reg.gauge("fleet.queue_depth").set(
+        float(process if queue_depth is None else queue_depth))
+    return FD.local_snapshot(reg, process=process)
+
+
+def _latency_parts(nproc=3, per=200, seed=11):
+    rng = np.random.default_rng(seed)
+    # lognormal spread over ~3 decades exercises many buckets
+    return [rng.lognormal(mean=-3.0 + p, sigma=1.0, size=per)
+            for p in range(nproc)]
+
+
+# -- merge exactness ---------------------------------------------------------
+
+
+def test_federated_quantiles_exactly_equal_merged_quantile():
+    """The tentpole equality: the federated p50/p95/p99 over N>=2
+    process snapshots == merged_quantile over the revived group ==
+    the quantile of ONE registry that observed every value."""
+    parts = _latency_parts(nproc=3)
+    snaps = [_proc_snapshot(p, vals) for p, vals in enumerate(parts)]
+    view = FD.merge_snapshots(snaps)
+
+    group = view.merged("fleet.job_e2e_s", tenant="acme")
+    assert len(group) == 3
+    # ground truth: a single fleet-wide histogram over all values
+    ref = M.MetricsRegistry().histogram("fleet.job_e2e_s", tenant="acme")
+    for vals in parts:
+        for v in vals:
+            ref.observe(float(v))
+    for q in (0.5, 0.95, 0.99):
+        fed = view.quantile("fleet.job_e2e_s", q, tenant="acme")
+        assert fed == M.merged_quantile(group, q)
+        assert fed == ref.quantile(q)
+    # bucket-wise merge state matches the fleet-wide registry exactly
+    merged_counts = [sum(cs) for cs in
+                     zip(*(h.bucket_counts for h in group))]
+    assert merged_counts == ref.bucket_counts
+    assert min(h.min for h in group) == ref.min
+    assert max(h.max for h in group) == ref.max
+
+
+def test_counter_and_gauge_merge_semantics():
+    """Counters sum across processes; gauges stay per-process under a
+    process=i label (a queue depth is not summable)."""
+    snaps = [_proc_snapshot(0, [0.1], jobs_done=3, queue_depth=5.0),
+             _proc_snapshot(1, [0.2], jobs_done=4, queue_depth=2.0)]
+    view = FD.merge_snapshots(snaps)
+    assert view.counters["fleet.jobs_done"] == pytest.approx(7.0)
+    assert view.gauges[
+        M.flat_name("fleet.queue_depth", {"process": "0"})] == 5.0
+    assert view.gauges[
+        M.flat_name("fleet.queue_depth", {"process": "1"})] == 2.0
+    # no process-less gauge key leaks into the merged view
+    assert "fleet.queue_depth" not in view.gauges
+    assert "fleet.queue_depth" not in view.counters
+
+
+# -- live federated scrape ---------------------------------------------------
+
+
+def test_federated_scrape_roundtrips_with_process_label(monkeypatch):
+    """A real HTTP scrape of /metrics/federated: per-process histogram
+    families carry process=i and parse back bucket-exact; the summed
+    counter appears once, without a process label."""
+    parts = _latency_parts(nproc=2, per=64, seed=23)
+    coord_reg = M.MetricsRegistry()
+    h0 = coord_reg.histogram("fleet.job_e2e_s", tenant="acme")
+    for v in parts[0]:
+        h0.observe(float(v))
+    coord_reg.counter("fleet.jobs_done").inc(3)
+    fed = FD.Federation(peers=[], registry=coord_reg)
+    fed.register_provider(lambda: _proc_snapshot(1, parts[1], jobs_done=4))
+    monkeypatch.setattr(FD, "FED", fed)
+
+    ex = E.MetricsExporter(port=0).start()
+    try:
+        body = urllib.request.urlopen(
+            ex.url + "/metrics/federated").read().decode()
+        fedjson = urllib.request.urlopen(ex.url + "/federate").read()
+    finally:
+        ex.stop()
+
+    import json
+
+    local = json.loads(fedjson)
+    assert local["schema"] == FD.SNAPSHOT_SCHEMA
+    assert any(c["name"] == "fleet.jobs_done"
+               for c in local["counters"])
+
+    fams = E.parse_histograms(body)
+    view = fed.view()
+    for p in ("0", "1"):
+        keys = [k for k in fams
+                if k[0] == "cup3d_fleet_job_e2e_s"
+                and ("process", p) in k[1] and ("tenant", "acme") in k[1]]
+        assert keys, (p, sorted(fams))
+        fam = fams[keys[0]]
+        assert fam["count"] == len(parts[int(p)])
+        cums = [c for _, c in fam["buckets"]]
+        assert cums == sorted(cums)
+        assert fam["buckets"][-1][1] == fam["count"]
+    # the summed counter renders once, process-less
+    flat = E.parse_prometheus_text(body)
+    ckeys = [k for k in flat if k[0] == "cup3d_fleet_jobs_done"]
+    assert ckeys == [("cup3d_fleet_jobs_done", frozenset())]
+    assert flat[ckeys[0]] == pytest.approx(7.0)
+    assert view.counters["fleet.jobs_done"] == pytest.approx(7.0)
+
+
+# -- straggler detection -----------------------------------------------------
+
+
+def test_straggler_alert_fires_on_slow_shard_quiet_when_balanced():
+    """Balanced shards -> no stragglers; one 5x shard -> exactly that
+    shard flagged, counter bumped, alert ring + warnings populated —
+    and the gauge/alert surface round-trips through a live /metrics
+    scrape."""
+    watch = FD.StragglerWatch(ratio=2.0)
+    for s in range(4):
+        watch.record(s, 0.10, source="test")
+    quiet = watch.evaluate(source="test")
+    assert quiet["stragglers"] == [] and watch.warnings() == []
+    assert quiet["skew_ratio"] == pytest.approx(1.0)
+
+    watch.record(2, 0.50, source="test")
+    skew = watch.evaluate(source="test", step=7)
+    assert skew["stragglers"] == [2]
+    assert watch.warnings() == [2]
+    assert watch.straggler_counts[2] == 1
+    assert skew["skew_ratio"] == pytest.approx(5.0)
+    alert = watch.alerts[-1]
+    assert alert["shard"] == 2 and alert["step"] == 7
+    assert alert["threshold"] == 2.0
+    health = watch.health()
+    assert health["warnings"] == [2]
+    assert health["last_walls"]["2"] == pytest.approx(0.5)
+
+    # the gauges/counters the watch set live in the global registry:
+    # a real scrape must carry them (acceptance: round-trips /metrics)
+    ex = E.MetricsExporter(port=0).start()
+    try:
+        body = urllib.request.urlopen(ex.url + "/metrics").read().decode()
+    finally:
+        ex.stop()
+    flat = E.parse_prometheus_text(body)
+    assert flat[("cup3d_fleet_shard_skew_ratio",
+                 frozenset())] == pytest.approx(5.0)
+    assert flat[("cup3d_fleet_shard_last_k_wall_s",
+                 frozenset({("shard", "2")}))] == pytest.approx(0.5)
+    assert flat[("cup3d_fleet_stragglers",
+                 frozenset({("shard", "2")}))] >= 1.0
+
+
+def test_federated_view_skew_spans_processes():
+    """Cross-process skew: each process contributes its own shard
+    walls; the federated assessment flags the slow process's shard."""
+    s0 = _proc_snapshot(0, [0.1])
+    s1 = _proc_snapshot(1, [0.1])
+    s0["shard_walls"] = {"0": 0.1, "1": 0.1}
+    s1["shard_walls"] = {"2": 0.1, "3": 0.45}
+    view = FD.merge_snapshots([s0, s1])
+    skew = view.skew(ratio=2.0)
+    assert skew["shards"] == 4
+    assert skew["stragglers"] == ["1/3"]
+    assert skew["skew_ratio"] == pytest.approx(4.5)
+
+
+# -- XLA cost harvest --------------------------------------------------------
+
+
+def test_cost_harvest_sane_for_fused_bicgstab():
+    """Compiler-counted flops/bytes for one fixed-k fused-solve
+    executable: available on this backend, positive, and at least the
+    analytic per-cell floor (nothing is executed to get them)."""
+    import jax.numpy as jnp
+
+    from cup3d_tpu.grid.uniform import BC, UniformGrid
+    from cup3d_tpu.ops import fused_bicgstab as fb
+    from cup3d_tpu.ops import krylov
+
+    g = UniformGrid((16, 16, 16), (1.0, 1.0, 1.0), (BC.periodic,) * 3)
+    rng = np.random.default_rng(3)
+    rhs = jnp.asarray(rng.standard_normal(g.shape), jnp.float32)
+    bt = krylov.to_lanes(rhs - jnp.mean(rhs))
+    row = fb.harvest_costs(g, bt, maxiter=1, store_dtype=jnp.float32)
+    assert row is not None
+    assert row["available"]["cost"], row
+    cells = 16 ** 3
+    # one BiCGSTAB body is two Laplacian applies + several axpys over
+    # every cell: > 10 flops/cell, and nowhere near 1e6 flops/cell
+    assert 10 * cells < row["flops"] < 1e6 * cells
+    # every cell is at least read+written once in f32
+    assert row["bytes_accessed"] > 2 * 4 * cells
+    # the memory half: peak >= the residual field itself
+    if row["available"]["memory"]:
+        assert row["peak_bytes"] >= 4 * cells
+    # harvest registered the row for perfwatch/bench consumers
+    from cup3d_tpu.obs import costs as OC
+
+    assert any(name.startswith("fused_bicgstab_k1")
+               for name in OC.rows())
+
+
+# -- zero-device-sync guarantee ----------------------------------------------
+
+
+def test_armed_idle_federation_transfer_clean_and_retrace_budget():
+    """Armed federation + straggler boundaries on an idle loop: no
+    implicit device transfer, no compile beyond the steady-state
+    budget — the K-boundary seams are host dict/scalar work only."""
+    from cup3d_tpu.analysis import runtime as R
+
+    reg = M.MetricsRegistry()
+    reg.counter("idle.ticks").inc()
+    fed = FD.Federation(providers=[], peers=[], registry=reg).arm()
+    watch = FD.StragglerWatch(ratio=2.0)
+    with R.RecompileCounter() as rc:
+        with R.no_implicit_transfers():
+            for step in range(6):
+                fed.on_k_boundary()
+                watch.boundary([0, 1], source="idle", step=step)
+                view = fed.view()
+    rc.assert_steady_state(budget=1)
+    assert fed.boundaries == 6
+    assert view.counters["idle.ticks"] == 1.0
+    # balanced by construction (both shards share the dispatch wall)
+    assert watch.warnings() == []

@@ -1,9 +1,10 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX015
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX017
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
 # swallowed-exception, JX011 bf16-reduction-accumulator, JX012
 # profiler-outside-obs, JX013 per-lane-loop, JX014
-# wall-clock-duration and JX015 per-tick-batch-reassembly rules)
+# wall-clock-duration, JX015 per-tick-batch-reassembly, JX016
+# sharded-materialization and JX017 hand-typed-hardware-peak rules)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
 # + the obs trace schema selftest (tools/trace_check.py), the
 # device-attribution parser selftest (obs/profile.py), the bench-
@@ -76,6 +77,13 @@ echo "== python -m cup3d_tpu.analysis --rules JX016" \
      "cup3d_tpu/sim cup3d_tpu/fleet cup3d_tpu/parallel"
 python -m cup3d_tpu.analysis --rules JX016 \
     cup3d_tpu/sim cup3d_tpu/fleet cup3d_tpu/parallel -q
+
+# the hand-typed-hardware-peak rule on its own line (round 19): a
+# spec-sheet literal (197e12 / 819e9) creeping back into a roofline or
+# bench path fails CI identifiably — peaks live in the obs/costs.py
+# device-kind table and are resolved via obs.costs.device_peaks()
+echo "== python -m cup3d_tpu.analysis --rules JX017 $PATHS tools/"
+python -m cup3d_tpu.analysis --rules JX017 $PATHS tools/ -q
 
 # fused-kernel smoke (round 12): the interpret-mode selftest exercises
 # every Pallas stage of the fused BiCGSTAB driver without a TPU

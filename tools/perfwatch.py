@@ -72,7 +72,13 @@ def selftest() -> None:
     def mk(cells, iter_ms, p95, fleet, amr_scale=1.0):
         return {"value": cells, "unit": "cells/s",
                 "fish": {"wall_per_step_p95_s": p95,
-                         "roofline": {"bicgstab_iter_device_ms": iter_ms}},
+                         # round 19: the compiler-counted per-iteration
+                         # HBM bytes ride the same roofline block — a
+                         # RISE (more traffic per iteration) regresses
+                         "roofline": {"bicgstab_iter_device_ms": iter_ms,
+                                      "legacy": {"compiler": {
+                                          "bytes_per_iter":
+                                          5.4e6 / amr_scale}}}},
                 "fleet32": {"fleet_cells_per_s": fleet},
                 # round 15: the adaptive config rides the same store —
                 # its iter-ms lives under roofline.fused when the fused
@@ -114,7 +120,7 @@ def selftest() -> None:
                      "wall_per_step_p95_s", "fleet_cells_per_s",
                      "amr_cells_per_s", "amr_bicgstab_iter_device_ms",
                      "fleet_job_p99_s", "fleet_occupancy",
-                     "mesh_cells_per_s"):
+                     "mesh_cells_per_s", "fish_bicgstab_bytes_compiler"):
             assert by[name]["regressed"], (name, by[name])
         # a malformed line is skipped, not fatal
         with open(store.path, "a") as f:

@@ -18,7 +18,9 @@ Checks, per ``cup3d_tpu.obs.trace`` schema version %d:
   including non-decreasing per-job event timelines;
 - step indices are non-decreasing across step AND device records
   (job records are exempt: their ``step`` is the job's own step count,
-  and terminal records land in completion order);
+  and terminal records land in completion order; ``kind="shard"``
+  records — round-19 mesh straggler boundaries — are exempt too: the
+  fleet stamps them with its dispatch index, not the simulation step);
 - the Chrome trace-event export built from the records (plus, when a
   ``trace.pfto.json`` sits next to the input, that file itself) parses
   back and every event carries name/ph/ts, with step spans exposing
@@ -29,7 +31,10 @@ Checks, per ``cup3d_tpu.obs.trace`` schema version %d:
 - per-lane job-occupancy tracks (pid 3, fleet/server.py) need their own
   ``process_name`` metadata event, a ``job_id`` arg on every occupancy
   span, and NON-OVERLAPPING spans per lane track — a lane serves one
-  job at a time, so overlap means the emission is lying.
+  job at a time, so overlap means the emission is lying;
+- per-shard K-boundary tracks (pid 4, round 19: obs/federate.py
+  straggler watch) need their own ``process_name`` metadata event and
+  a ``shard`` arg on every boundary span.
 
 ``--selftest`` (what ``tools/lint.sh`` runs, no simulation needed)
 drives a private TraceSink through spans + step records in a temp dir,
@@ -71,9 +76,10 @@ def validate_jsonl(path: str) -> list:
                 raise SystemExit(
                     f"{path}:{i}: schema violation(s): {problems}"
                 )
-            if rec.get("kind", "step") != "job":
+            if rec.get("kind", "step") not in ("job", "shard"):
                 # job records carry the JOB's step count and land in
-                # completion order — only step/device records share the
+                # completion order; shard records carry the fleet's
+                # dispatch index — only step/device records share the
                 # simulation's monotonic step axis
                 if rec["step"] < last_step:
                     raise SystemExit(
@@ -99,11 +105,28 @@ def _check_chrome(obj: dict, origin: str, want_steps: int) -> int:
     device_ops = 0
     device_named = False
     lane_named = False
+    shard_named = False
+    shard_spans = 0
     lane_spans = {}  # tid -> [(ts, dur)] job-occupancy spans
     for e in events:
         for k in ("name", "ph", "ts"):
             if k not in e:
                 raise SystemExit(f"{origin}: event missing {k!r}: {e}")
+        if e.get("pid") == obs_trace.SHARD_PID:
+            # round 19: per-shard K-boundary tracks (obs/federate.py)
+            if e["ph"] == "M" and e["name"] == "process_name":
+                shard_named = True
+                continue
+            if e["ph"] != "X":
+                continue
+            if "dur" not in e:
+                raise SystemExit(f"{origin}: shard span without dur: {e}")
+            if "shard" not in e.get("args", {}):
+                raise SystemExit(
+                    f"{origin}: shard span without shard arg: {e}"
+                )
+            shard_spans += 1
+            continue
         if e.get("pid") == obs_trace.LANE_PID:
             # round 16: per-lane job-occupancy tracks (fleet/server.py)
             if e["ph"] == "M" and e["name"] == "process_name":
@@ -150,6 +173,11 @@ def _check_chrome(obj: dict, origin: str, want_steps: int) -> int:
         raise SystemExit(
             f"{origin}: lane spans present but no process_name metadata "
             f"for pid {obs_trace.LANE_PID}"
+        )
+    if shard_spans and not shard_named:
+        raise SystemExit(
+            f"{origin}: shard spans present but no process_name "
+            f"metadata for pid {obs_trace.SHARD_PID}"
         )
     for tid, spans in lane_spans.items():
         spans.sort()
@@ -285,8 +313,47 @@ def selftest() -> None:
             assert "overlapping job spans" in str(e), e
         else:
             raise AssertionError("overlapping lane spans not caught")
+    # round 19: the distributed observatory — kind="shard" K-boundary
+    # aux records plus pid-4 per-shard tracks produced through the same
+    # straggler-watch path the dispatch seams drive must validate, and
+    # the validator must FIRE on a malformed shard record
+    from cup3d_tpu.obs import federate as obs_federate
+
+    with tempfile.TemporaryDirectory() as td:
+        sink = obs_trace.TraceSink(enabled=True, directory=td)
+        timer = obs_trace.SpanTimer(sink=sink)
+        obsr = obs_trace.StepObserver(timer, kind="selftest")
+        with obsr.step(0, 0.0, 0.1):
+            pass
+        watch = obs_federate.StragglerWatch(ratio=2.0)
+        for shard, wall in ((0, 0.1), (1, 0.1), (2, 0.5)):
+            watch.record(shard, wall, source="selftest")
+        skew = watch.evaluate(source="selftest", sink=sink, step=0,
+                              t0=obs_trace.now(), dur=0.5)
+        assert skew["stragglers"] == [2], skew
+        sink.close()
+        records = validate_jsonl(os.path.join(td, "trace.jsonl"))
+        shards = [r for r in records if r.get("kind") == "shard"]
+        assert len(shards) == 3, [r.get("kind") for r in records]
+        assert sum(1 for r in shards if r["straggler"]) == 1, shards
+        with open(os.path.join(td, "trace.pfto.json")) as f:
+            _check_chrome(json.load(f), "<shard export>", 1)
+        # the shard validator has teeth: a boundary record without its
+        # wall must fail the jsonl validation identifiably
+        bad_rec = obs_trace.shard_record(0, 0, 0.1, 1.0,
+                                         source="selftest")
+        del bad_rec["wall_s"]
+        bad_path = os.path.join(td, "bad.jsonl")
+        with open(bad_path, "w") as f:
+            f.write(json.dumps(bad_rec) + "\n")
+        try:
+            validate_jsonl(bad_path)
+        except SystemExit as e:
+            assert "wall_s" in str(e), e
+        else:
+            raise AssertionError("malformed shard record not caught")
     print("trace_check selftest: OK (incl. merged host+device, "
-          "job records + lane tracks)")
+          "job records + lane tracks, shard boundary tracks)")
 
 
 def main(argv=None) -> int:
