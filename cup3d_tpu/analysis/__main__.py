@@ -8,6 +8,15 @@ Exit status 0 iff every violation is either inline-annotated
     python -m cup3d_tpu.analysis cup3d_tpu/ bench.py   # + the bench
     python -m cup3d_tpu.analysis --write-baseline ...  # start a burn-down
     python -m cup3d_tpu.analysis --no-baseline ...     # the raw picture
+
+The second tier — the IR audit (rules JP001-JP005, traced jaxprs and
+AOT-lowered executables of the canonical entry points) — runs as the
+``audit`` subcommand::
+
+    python -m cup3d_tpu.analysis audit                 # whole registry
+    python -m cup3d_tpu.analysis audit --format json   # CI one-liner
+    python -m cup3d_tpu.analysis audit --entries uniform_tgv_megaloop
+    python -m cup3d_tpu.analysis audit --write-baseline
 """
 
 from __future__ import annotations
@@ -20,7 +29,93 @@ from cup3d_tpu.analysis import lint as lint_mod
 from cup3d_tpu.analysis.rules import RULES
 
 
+def main_audit(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cup3d_tpu.analysis audit",
+        description="IR audit: jaxpr/HLO checks over the canonical "
+                    "entry points (rules JP001-JP005)",
+    )
+    ap.add_argument("--entries", default=None,
+                    help="comma-separated registry entry names "
+                         "(default: all)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule IDs (e.g. JP001,JP003)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: "
+                         "analysis/audit_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-entries", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only failing violations")
+    args = ap.parse_args(argv)
+
+    # platform bootstrap must precede the first jax device access —
+    # audit.py imports jax lazily for exactly this reason
+    from cup3d_tpu.analysis import audit as audit_mod
+
+    audit_mod.bootstrap_platform()
+
+    if args.list_entries:
+        for ep in audit_mod.REGISTRY:
+            mode = ("no-donation contract" if ep.expect_no_donation
+                    else "donation checked")
+            extra = "" if ep.compile else " (lowered-only)"
+            print(f"{ep.name}  [{mode}{extra}]")
+            for rule, reason in sorted(ep.allow.items()):
+                print(f"    allow({rule}): {reason}")
+        return 0
+
+    entries = None
+    if args.entries:
+        wanted = {e.strip() for e in args.entries.split(",")}
+        by_name = {ep.name: ep for ep in audit_mod.REGISTRY}
+        unknown = wanted - set(by_name)
+        if unknown:
+            ap.error(f"unknown entries: {sorted(unknown)} "
+                     f"(have: {sorted(by_name)})")
+        entries = [by_name[n] for n in sorted(wanted)]
+
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = args.baseline or audit_mod.default_baseline_path()
+    rules = (set(r.strip().upper() for r in args.rules.split(","))
+             if args.rules else None)
+
+    violations, metas = audit_mod.run_audit(
+        entries, baseline_path=baseline_path, rules=rules)
+
+    if args.write_baseline:
+        out = args.baseline or audit_mod.default_baseline_path()
+        lint_mod.write_baseline(violations, out)
+        print(f"audit baseline written: {out} "
+              f"({len(lint_mod.failing(violations))} entries to justify)")
+        return 0
+
+    failing = lint_mod.failing(violations)
+    if args.format == "json":
+        print(audit_mod.summary_line(violations, metas, baseline_path))
+    else:
+        shown = failing if args.quiet else violations
+        for v in shown:
+            print(v.format())
+        n_sup = sum(1 for v in violations if v.suppressed)
+        n_base = sum(1 for v in violations if v.baselined)
+        n_skip = sum(1 for m in metas if m.get("skipped"))
+        print(
+            f"ir-audit: {len(metas)} entries ({n_skip} skipped), "
+            f"{len(violations)} finding(s): {len(failing)} failing, "
+            f"{n_sup} annotated, {n_base} baselined"
+        )
+    return 1 if failing else 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "audit":
+        return main_audit(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m cup3d_tpu.analysis",
         description="JAX-aware AST lint (rules JX001-JX008)",

@@ -102,6 +102,14 @@ Rule summary (full rationale in ``analysis/rules.py``):
          device-kind table (the one exempt module); consumers call
          ``device_peaks()``.  Scope: ``bench*.py`` files plus any
          function named like roofline/peak-model in the package.
+- JX018  raw collective call site outside ``cup3d_tpu/parallel/``:
+         ``lax.ppermute``/``psum``/``pmax``/``all_gather``/... called
+         directly anywhere else in the package scatters the SPMD
+         communication surface across the tree.  Collectives go
+         through the parallel/ layer (``ring.py`` ring_shift/pad_slab,
+         ``collectives.py`` all_gather_tiled/pmax_axis) so the IR
+         audit (JP002) has ONE seam to prove permutation/axis
+         invariants on and a mesh-topology change edits one module.
 """
 
 from __future__ import annotations
@@ -231,6 +239,19 @@ JX016_BUILDER_RE = re.compile(r"^(make_|build_|bind_|_build_)")
 #: jax's default device (a cross-shard gather when the input was
 #: sharded); device_put WITH an explicit sharding argument stays legal
 JX016_HOST_PULLS = frozenset({"device_get", "asarray", "array"})
+
+#: JX018: the communicating collectives (device<->device exchange under
+#: a named axis).  ``axis_index`` is deliberately absent — it is a
+#: shard-LOCAL coordinate read with no communication (the fleet's
+#: shard-local lane upload uses it legitimately outside parallel/).
+JX018_COLLECTIVES = frozenset(
+    {"ppermute", "pshuffle", "psum", "psum_scatter", "pmax", "pmin",
+     "pmean", "all_gather", "all_to_all", "pbroadcast"}
+)
+
+#: JX018 exemption: the parallel/ layer IS the sanctioned collective
+#: seam (ring.py, compat.py, collectives.py, topology.py)
+JX018_EXEMPT_RE = re.compile(r"cup3d_tpu/parallel/")
 
 #: JX017 scope: the bench entrypoints (any bench*.py) and, anywhere in
 #: the tree, functions whose names say they place work on a roofline
@@ -528,6 +549,12 @@ class FileLint:
                 or JX017_FUNC_RE.search(func.name)
             ):
                 self._check_hardware_peaks(func, qualname)  # JX017
+            if (self.path.startswith("cup3d_tpu/")
+                    and not JX018_EXEMPT_RE.search(self.path)):
+                self._check_raw_collectives(func, qualname)  # JX018
+        if (self.path.startswith("cup3d_tpu/")
+                and not JX018_EXEMPT_RE.search(self.path)):
+            self._check_raw_collectives(self.tree, "<module>")  # JX018
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         self._check_wallclock_duration(self.tree, "<module>")  # JX014
@@ -1369,6 +1396,35 @@ class FileLint:
                 "cross-shard gather under the 2-D mesh; slice shard-"
                 "locally under shard_map or place with an explicit "
                 "`device_put(x, sharding)`",
+            )
+
+    # -- JX018 -------------------------------------------------------------
+
+    def _check_raw_collectives(self, func: ast.AST, qualname: str) -> None:
+        """Raw communicating-collective call sites outside the
+        ``cup3d_tpu/parallel/`` seam (JX018).  Matches ``lax.psum`` /
+        ``jax.lax.ppermute`` / bare ``all_gather`` (from-import) style
+        calls whose leaf name is one of JX018_COLLECTIVES; dotted
+        prefixes other than jax/lax (e.g. a wrapper object's method)
+        never fire.  ``axis_index`` is exempt by omission — it reads a
+        shard-local coordinate and communicates nothing."""
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in JX018_COLLECTIVES:
+                continue
+            root = name.split(".", 1)[0]
+            if "." in name and root not in ("jax", "lax"):
+                continue
+            self._emit(
+                "JX018", node, qualname,
+                f"raw collective `{name}()` outside cup3d_tpu/parallel/ "
+                "— route it through the parallel/ seam (ring.ring_shift, "
+                "collectives.all_gather_tiled/pmax_axis, ...) so the IR "
+                "audit has one place to prove axis/permutation "
+                "invariants",
             )
 
     # -- JX017 -------------------------------------------------------------

@@ -238,6 +238,90 @@ RULES: Dict[str, Rule] = {
             "obs spans under CUP3D_TRACE_XLA=1 instead.",
         ),
         Rule(
+            "JX018",
+            "raw collective call site outside cup3d_tpu/parallel/",
+            "jax.lax.ppermute/psum/pmax/all_gather/all_to_all/... called "
+            "directly outside cup3d_tpu/parallel/ scatters the SPMD "
+            "communication surface across the tree: the IR audit "
+            "(analysis/ir.py JP002) and the pod bring-up work need ONE "
+            "seam where axis names, permutation structure, and mesh "
+            "shape assumptions live.  Collectives go through the "
+            "parallel/ layer (parallel/ring.py ring_shift/pad_slab, "
+            "parallel/collectives.py all_gather_tiled/pmax_axis) so a "
+            "mesh-axis rename or a topology change edits one module "
+            "instead of every call site — the exact MPI-communicator "
+            "discipline the reference C++ enforces by construction.",
+        ),
+        Rule(
+            "JP001",
+            "donated buffer not aliased in the compiled executable",
+            "jit(donate_argnums=...) is a PROMISE, not a guarantee: when "
+            "XLA cannot alias a donated input to an output (shape/dtype "
+            "mismatch, layout change, or an output that is not a pure "
+            "update) it silently copies and the donation evaporates — "
+            "the steady-state megaloop then carries 2x the field working "
+            "set in HBM, exactly what donation exists to prevent (JX002 "
+            "rationale, ~400 MB of vel+p at 256^3).  The audit traces "
+            "the canonical executables and requires every donated leaf "
+            "to appear in the compiled input_output_aliases (or the "
+            "lowered tf.aliasing_output marks); an entry that documents "
+            "a no-donation contract (fleet advance: rollback needs the "
+            "pre-dispatch buffers) declares it and is checked for the "
+            "ABSENCE of donation instead.",
+        ),
+        Rule(
+            "JP002",
+            "unsafe collective in a shard_map body",
+            "A ppermute whose (src, dst) pairs are not a permutation "
+            "(duplicate sources, duplicate destinations, or ids outside "
+            "the mesh axis) and any collective naming an axis that does "
+            "not exist in the enclosing mesh are exactly the class of "
+            "bug that deadlocks or corrupts a multi-host pod at runtime "
+            "— jax does NOT validate either at trace time.  The "
+            "reference C++ relies on MPI runtime assertions here; the "
+            "audit walks every shard_map body in the canonical jaxprs "
+            "and proves the permutation/axis invariants before any "
+            "jax.distributed run is real.",
+        ),
+        Rule(
+            "JP003",
+            "cross-shard materialization in a sharded step jaxpr",
+            "An all_gather inside a mesh-sharded steady-state step "
+            "reassembles a full axis on every shard, every step — the "
+            "compiler-truth complement of AST rule JX016 (which can "
+            "only see host-side gathers in source text).  A gather that "
+            "is part of the design (the sharded megaloop's replicated "
+            "coarse solve) is annotated at the registry entry with a "
+            "reason; anything else is a scale-out ceiling hiding in "
+            "the IR.",
+        ),
+        Rule(
+            "JP004",
+            "precision hazard visible in the jaxpr",
+            "float64 avals or bf16-accumulated reductions (reduce_sum / "
+            "dot_general producing bfloat16) in a hot jaxpr are the "
+            "IR-grounded halves of JX005/JX011: dtype promotion "
+            "introduced two helpers away from the call site is "
+            "invisible to the AST linter but fully visible in the "
+            "traced IR.  f64 doubles bandwidth and VMEM pressure on "
+            "TPU; a bf16 accumulator loses ~8 of the ~11 significand "
+            "bits the Krylov stopping test needs (the round-12 policy "
+            "stores bf16 but accumulates f32 everywhere).",
+        ),
+        Rule(
+            "JP005",
+            "host callback op in a hot jaxpr",
+            "pure_callback/io_callback/debug_callback inside a "
+            "steady-state jaxpr inserts a host round trip into every "
+            "step: the dispatch stream blocks on the Python interpreter "
+            "(the JX001 hazard, but introduced at trace level where the "
+            "AST linter cannot see it), and on a multi-host pod the "
+            "callback runs per-process with unsynchronized side "
+            "effects.  Debug prints and host-side physics must stay "
+            "out of the megaloop; diagnostics ride the scan-stacked "
+            "row outputs instead.",
+        ),
+        Rule(
             "JX017",
             "hand-typed hardware peak literal in a roofline/bench path",
             "A numeric constant >= 1e9 that is not an exact power of "

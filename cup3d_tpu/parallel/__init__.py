@@ -1,3 +1,8 @@
+from cup3d_tpu.parallel.collectives import (  # noqa: F401
+    all_gather_tiled,
+    pmax_axis,
+    psum_axis,
+)
 from cup3d_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     field_sharding,

@@ -1,0 +1,49 @@
+"""Thin named wrappers over jax's communicating collectives.
+
+This module (with ``ring.py``'s permute transport and ``compat.py``'s
+shard_map shim) is the ONE sanctioned seam for device<->device
+collectives — the AST linter (JX018) fails any raw ``lax.psum`` /
+``lax.all_gather`` / ... call site outside ``cup3d_tpu/parallel/``, and
+the IR audit (analysis/ir.py JP002/JP003) proves axis-name and
+permutation invariants against the jaxprs these wrappers produce.  The
+wrappers add no behavior: each is exactly the underlying primitive, so
+rerouting a call site through here leaves the traced jaxpr (and every
+bitwise-equivalence test downstream) unchanged.
+
+Why a seam at all: the reference C++ routes every exchange through one
+MPI communicator object, which is what makes its runtime assertions
+possible.  Keeping the JAX collectives behind one module gives the
+same property to static analysis — a mesh-axis rename or a topology
+change edits one file, and the audit has a finite surface to reason
+about.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def all_gather_tiled(x, axis_name, *, axis=0):
+    """``lax.all_gather(..., tiled=True)``: concatenate the per-shard
+    blocks of ``x`` along ``axis`` across the mesh axis ``axis_name``
+    (the sharded megaloop's replicated-solve assembly).  Tiled form
+    only — the untiled (stacking) variant has no call site in the
+    tree, so the seam stays minimal."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def pmax_axis(x, axis_name):
+    """``lax.pmax``: elementwise max across the mesh axis ``axis_name``
+    (the megaloop's global umax reduction; fp max is exactly
+    associative, so the sharded result is bitwise equal to the solo
+    one)."""
+    return jax.lax.pmax(x, axis_name)
+
+
+def psum_axis(x, axis_name):
+    """``lax.psum``: elementwise sum across the mesh axis ``axis_name``.
+    Mind the round-12 precision policy at call sites: sum-reductions
+    over bf16-stored values must accumulate in f32 BEFORE the psum
+    (JX011/JP004) — the collective itself reduces in the operand
+    dtype."""
+    return jax.lax.psum(x, axis_name)

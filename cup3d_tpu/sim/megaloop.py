@@ -358,6 +358,7 @@ def make_tgv_step_sharded(s, axis="x"):
     solve runs replicated on the gathered rhs and each shard slices its
     own pressure slab (and its sx+2 gradient window) back out."""
     from cup3d_tpu.ops import stencils as st
+    from cup3d_tpu.parallel import collectives as coll
     from cup3d_tpu.parallel import ring as _ring
 
     grid, nu, dtype = s.grid, s.nu, s.dtype
@@ -378,16 +379,15 @@ def make_tgv_step_sharded(s, axis="x"):
         # projection: slab divergence, replicated global solve
         # (ops/projection.pressure_rhs semantics on the slab)
         rhs_l = st.divergence(pad_vec(vel, 1), 1, grid.h) / dt
-        rhs = jax.lax.all_gather(rhs_l, axis, axis=0, tiled=True)
-        p_full = solver(
-            rhs, jax.lax.all_gather(p, axis, axis=0, tiled=True))
+        rhs = coll.all_gather_tiled(rhs_l, axis)
+        p_full = solver(rhs, coll.all_gather_tiled(p, axis))
         sx = vel.shape[0]
         me = jax.lax.axis_index(axis)
         p_new = jax.lax.dynamic_slice_in_dim(p_full, me * sx, sx, axis=0)
         win = jax.lax.dynamic_slice_in_dim(
             grid.pad_scalar(p_full, 1), me * sx, sx + 2, axis=0)
         vel = vel - dt * st.grad(win, 1, grid.h)
-        umax_new = jax.lax.pmax(max_velocity(vel, uinf), axis)
+        umax_new = coll.pmax_axis(max_velocity(vel, uinf), axis)
         time_new = time + dt
         out = {"vel": vel, "p": p_new, "umax": umax_new,
                "time": time_new, "dt": dt}
@@ -448,6 +448,7 @@ def make_fish_step_sharded(s, ob, axis="x"):
         obstacle_probe_budget,
         window_size_cells,
     )
+    from cup3d_tpu.parallel import collectives as coll
     from cup3d_tpu.parallel import ring as _ring
 
     grid, nu, dtype = s.grid, s.nu, s.dtype
@@ -508,7 +509,7 @@ def make_fish_step_sharded(s, ob, axis="x"):
         udef = udef * (chi > 0)[..., None]
         # advection-diffusion on the slab, halos by ring permute
         vel = rk3_step(grid, vel, dt, nu, uinf, pad=pad_vec)
-        vel_full = jax.lax.all_gather(vel, axis, axis=0, tiled=True)
+        vel_full = coll.all_gather_tiled(vel, axis)
         mom = pack_moments(
             momentum_integrals_core(xc, h3, chi, vel_full, rigid[12:15]))
         out = rigid_update_device(mom, rigid, forced_mask, block_mask,
@@ -521,7 +522,7 @@ def make_fish_step_sharded(s, ob, axis="x"):
         vel_pen = penalize(vel_full, chi, ubody, lam, dt)
         PF = -per_obstacle_penalization_force(
             vel_pen, vel_full, (chi,), dt, h3, xc, cm[None])[0]
-        p_prev = jax.lax.all_gather(p, axis, axis=0, tiled=True)
+        p_prev = coll.all_gather_tiled(p, axis)
         vel_proj, p_full = project(grid, vel_pen, dt, solver, chi, udef,
                                    p_init=p_prev)
         stats = _solver_stats(dtype)

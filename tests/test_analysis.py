@@ -799,6 +799,69 @@ def test_jx017_in_tree_roofline_paths_are_clean():
     assert out.returncode == 0, out.stdout + out.stderr
 
 
+def test_jx018_raw_collective_fires_suppresses_and_scopes():
+    """Raw communicating collective outside the parallel/ seam (round
+    20): every psum/ppermute/all_gather call site must live in
+    cup3d_tpu/parallel/ so the IR audit has ONE seam to prove axis and
+    permutation invariants on."""
+    src = (
+        "import jax\n"
+        "def halo(x):\n"
+        "    y = jax.lax.ppermute(x, 'x', [(0, 1)])\n"
+        "    return jax.lax.psum(y, 'x')\n"
+    )
+    vs = _failing(src)
+    assert _rules(vs) == {"JX018"} and len(vs) == 2
+    assert "parallel/ seam" in vs[0].message
+    # bare from-import names fire too
+    bare = (
+        "from jax.lax import all_gather\n"
+        "def widen(x):\n"
+        "    return all_gather(x, 'x', axis=0, tiled=True)\n"
+    )
+    assert _rules(_failing(bare)) == {"JX018"}
+    # the sanctioned home: any parallel/ module is exempt by path
+    assert not _failing(src, "cup3d_tpu/parallel/ring.py")
+    assert not _failing(src, "cup3d_tpu/parallel/collectives.py")
+    # a wrapper object's method with a colliding leaf name never fires
+    wrapped = (
+        "def widen(coll, x):\n"
+        "    return coll.all_gather(x)\n"
+    )
+    assert not _failing(wrapped)
+    # axis_index communicates nothing and is exempt by omission
+    idx = (
+        "import jax\n"
+        "def lane(x):\n"
+        "    return jax.lax.axis_index('lanes')\n"
+    )
+    assert not _failing(idx)
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "    y = jax.lax.ppermute",
+        "    # jax-lint: allow(JX018, staging for parallel/ migration)\n"
+        "    y = jax.lax.ppermute",
+    )
+    all_vs = L.lint_source(ok, HOT)
+    fails = [v for v in L.failing(all_vs) if v.rule == "JX018"]
+    assert len(fails) == 1 and any(
+        v.rule == "JX018" and v.suppressed and
+        v.suppression_reason == "staging for parallel/ migration"
+        for v in all_vs)
+
+
+def test_jx018_package_is_clean():
+    """The burn-down stays burned down: after rerouting the sharded
+    megaloop through parallel/collectives.py, no raw collective call
+    site survives outside the seam (baseline EMPTY for this rule)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cup3d_tpu.analysis", "--rules", "JX018",
+         "--no-baseline", "cup3d_tpu/", "-q"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
 def test_jx014_wallclock_duration_fires_and_suppresses():
     """Wall-clock subtraction used as a duration (round 16): NTP slews
     and steps time.time(), so a latency computed from it can go

@@ -1,10 +1,13 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX017
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX018
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
 # swallowed-exception, JX011 bf16-reduction-accumulator, JX012
 # profiler-outside-obs, JX013 per-lane-loop, JX014
 # wall-clock-duration, JX015 per-tick-batch-reassembly, JX016
-# sharded-materialization and JX017 hand-typed-hardware-peak rules)
+# sharded-materialization, JX017 hand-typed-hardware-peak and JX018
+# raw-collective-outside-parallel/ rules)
+# + the IR audit (rules JP001-JP005: traced jaxprs + AOT alias maps of
+#   the canonical entry points, `python -m cup3d_tpu.analysis audit`)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
 # + the obs trace schema selftest (tools/trace_check.py), the
 # device-attribution parser selftest (obs/profile.py), the bench-
@@ -84,6 +87,24 @@ python -m cup3d_tpu.analysis --rules JX016 \
 # device-kind table and are resolved via obs.costs.device_peaks()
 echo "== python -m cup3d_tpu.analysis --rules JX017 $PATHS tools/"
 python -m cup3d_tpu.analysis --rules JX017 $PATHS tools/ -q
+
+# the raw-collective seam rule on its own line (round 20): a psum /
+# ppermute / all_gather call site creeping in outside cup3d_tpu/parallel/
+# fails CI identifiably — collectives route through the parallel/ seam
+# (ring.ring_shift, collectives.all_gather_tiled, ...) so the IR audit
+# has one place to prove axis/permutation invariants
+echo "== python -m cup3d_tpu.analysis --rules JX018 cup3d_tpu/"
+python -m cup3d_tpu.analysis --rules JX018 cup3d_tpu/ -q
+
+# the IR audit (round 20): trace + AOT-lower the canonical entry points
+# (uniform/fish/AMR megaloops, fleet advance+reseed, mesh-sharded
+# megaloop, fused BiCGSTAB stages) and check donation aliasing (JP001),
+# collective safety (JP002), sharded gathers (JP003), precision (JP004)
+# and host callbacks (JP005) against the EMPTY audit baseline.  Whole
+# registry runs in ~25 s on the CPU container (budget: 60 s) and prints
+# a one-line JSON summary for the CI tail.
+echo "== python -m cup3d_tpu.analysis audit --format json"
+timeout -k 5 60 python -m cup3d_tpu.analysis audit --format json
 
 # fused-kernel smoke (round 12): the interpret-mode selftest exercises
 # every Pallas stage of the fused BiCGSTAB driver without a TPU
