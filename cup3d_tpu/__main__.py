@@ -81,6 +81,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         from cup3d_tpu.fleet.cli import main as fleet_main
 
         raise SystemExit(fleet_main(args[1:]))
+    if args and args[0] == "aot":
+        # persistent-executable-store operations: `python -m cup3d_tpu
+        # aot warm|list|gc|verify|probe` (aot/cli.py) manage the
+        # zero-cold-start store and measure boot-to-first-dispatch
+        from cup3d_tpu.aot.cli import main as aot_main
+
+        raise SystemExit(aot_main(args[1:]))
     driver = build_driver(args)
     _log_config(driver)
     driver.init()

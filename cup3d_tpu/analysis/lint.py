@@ -110,6 +110,16 @@ Rule summary (full rationale in ``analysis/rules.py``):
          ``collectives.py`` all_gather_tiled/pmax_axis) so the IR
          audit (JP002) has ONE seam to prove permutation/axis
          invariants on and a mesh-topology change edits one module.
+- JX019  direct AOT compile / jit-warmup call site outside the
+         executable-store seam: a chained ``fn.lower(...).compile()``
+         or an immediately-invoked ``jit(f)(...)`` warmup produces an
+         XLA executable the persistent store (``cup3d_tpu/aot/``)
+         never sees — recompiled on every boot, invisible to the
+         aot.* telemetry.  Route compiles through ``aot.store_backed``
+         / ``StoreBackedExecutable.warm`` so seen signatures
+         deserialize instead.  ``cup3d_tpu/aot/`` is the seam itself
+         and ``obs/costs.py`` harvests from compiled objects — both
+         path-exempt.
 """
 
 from __future__ import annotations
@@ -267,6 +277,11 @@ JX017_EXEMPT_RE = re.compile(r"cup3d_tpu/obs/costs\.py$")
 #: of ten below/at any magnitude are unit conversions (1e9, 1e12), not
 #: hardware claims
 JX017_MIN_MAGNITUDE = 1e9
+
+#: JX019 exemption: cup3d_tpu/aot/ IS the store seam (its wrapper owns
+#: the one sanctioned lower().compile()), and obs/costs.py harvests
+#: cost analytics from an already-compiled object
+JX019_EXEMPT_RE = re.compile(r"cup3d_tpu/(aot/|obs/costs\.py$)")
 
 
 def _is_power_of_ten(v: float) -> bool:
@@ -552,9 +567,15 @@ class FileLint:
             if (self.path.startswith("cup3d_tpu/")
                     and not JX018_EXEMPT_RE.search(self.path)):
                 self._check_raw_collectives(func, qualname)  # JX018
+            if (self.path.startswith("cup3d_tpu/")
+                    and not JX019_EXEMPT_RE.search(self.path)):
+                self._check_aot_seam(func, qualname)        # JX019
         if (self.path.startswith("cup3d_tpu/")
                 and not JX018_EXEMPT_RE.search(self.path)):
             self._check_raw_collectives(self.tree, "<module>")  # JX018
+        if (self.path.startswith("cup3d_tpu/")
+                and not JX019_EXEMPT_RE.search(self.path)):
+            self._check_aot_seam(self.tree, "<module>")     # JX019
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         self._check_wallclock_duration(self.tree, "<module>")  # JX014
@@ -1458,6 +1479,51 @@ class FileLint:
                 "obs/costs.py table is the one sanctioned home for "
                 "spec-sheet numbers)",
             )
+
+    # -- JX019 -------------------------------------------------------------
+
+    def _check_aot_seam(self, func: ast.AST, qualname: str) -> None:
+        """Direct AOT compile / jit-warmup call site outside the
+        executable-store seam (JX019).  Two shapes fire: a chained
+        ``fn.lower(...).compile()`` (Attribute ``compile`` called on a
+        Call of Attribute ``lower``) and an immediately-invoked
+        ``jit(f)(...)`` / ``jax.jit(f)(...)`` warmup.  Both compile an
+        XLA executable the persistent store never sees — paid again
+        every boot, invisible to the aot.* counters.  Split lowering
+        (``lowered = fn.lower(...)`` then introspection, the
+        analysis/audit.py pattern) never fires: IR-only reads are not
+        warmups."""
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "compile"
+                    and isinstance(f.value, ast.Call)
+                    and isinstance(f.value.func, ast.Attribute)
+                    and f.value.func.attr == "lower"):
+                self._emit(
+                    "JX019", node, qualname,
+                    "chained `.lower().compile()` outside the "
+                    "cup3d_tpu/aot/ store seam — wrap the jitted "
+                    "callable with aot.store_backed() and call "
+                    ".warm()/.ensure_compiled() so previously-seen "
+                    "signatures deserialize instead of recompiling",
+                )
+                continue
+            if isinstance(f, ast.Call):
+                name = _call_name(f)
+                leaf = name.rsplit(".", 1)[-1]
+                root = name.split(".", 1)[0]
+                if leaf == "jit" and ("." not in name
+                                      or root == "jax"):
+                    self._emit(
+                        "JX019", node, qualname,
+                        f"immediately-invoked `{name}(...)(...)` "
+                        "warmup compiles outside the cup3d_tpu/aot/ "
+                        "store seam — bind the jit once, wrap it with "
+                        "aot.store_backed(), and warm through the "
+                        "wrapper",
+                    )
 
     # -- JX009 -------------------------------------------------------------
 

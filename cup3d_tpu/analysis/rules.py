@@ -253,6 +253,23 @@ RULES: Dict[str, Rule] = {
             "discipline the reference C++ enforces by construction.",
         ),
         Rule(
+            "JX019",
+            "direct AOT compile / jit-warmup call site outside the "
+            "executable-store seam",
+            "A chained `fn.lower(...).compile()` or an immediately-"
+            "invoked `jit(f)(...)` warmup compiles an XLA executable "
+            "that the persistent store (cup3d_tpu/aot/store.py) never "
+            "sees: the result is paid again on every process start — "
+            "the exact cold-start tax round 21 eliminates — and the "
+            "compile evades the aot.* hit/miss/compile-seconds "
+            "telemetry.  Compile-producing call sites go through the "
+            "store seam (aot.store_backed / StoreBackedExecutable."
+            "warm/ensure_compiled) so previously-seen signatures "
+            "deserialize instead of recompiling.  cup3d_tpu/aot/ IS "
+            "the seam and obs/costs.py harvests cost analytics from "
+            "an already-compiled object — both are path-exempt.",
+        ),
+        Rule(
             "JP001",
             "donated buffer not aliased in the compiled executable",
             "jit(donate_argnums=...) is a PROMISE, not a guarantee: when "

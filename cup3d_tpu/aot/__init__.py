@@ -1,0 +1,25 @@
+"""Persistent AOT executable store + background compile service
+(ISSUE 18, round 21).
+
+``aot/store.py`` keeps serialized XLA executables on disk, keyed by
+content signature and environment fingerprint, so a restarted server
+or a fresh elastic replica loads its compiled step functions instead
+of re-tracing them.  ``aot/compiler.py`` moves cold-signature compiles
+off the fleet dispatch thread and pre-compiles neighboring capacity
+rungs speculatively.  ``aot/cli.py`` is the ``python -m cup3d_tpu
+aot`` operator surface (``warm`` / ``list`` / ``gc`` / ``verify`` /
+``probe``).
+
+Everything is opt-in behind ``CUP3D_AOT_STORE``: with the env var
+unset every seam (``fleet/server.py executable()``,
+``parallel/forest.py bind_step_executable``) behaves exactly as
+before — same objects, same compile timing, zero overhead.
+"""
+
+from cup3d_tpu.aot.store import (  # noqa: F401
+    ExecutableStore,
+    StoreBackedExecutable,
+    active_store,
+    fingerprint,
+    store_backed,
+)

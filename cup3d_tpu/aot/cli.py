@@ -1,0 +1,270 @@
+"""Operator entrypoint: ``python -m cup3d_tpu aot <cmd>``.
+
+Four store-management subcommands plus one measurement probe, all
+printing machine-parseable JSON on stdout:
+
+``list``
+    every entry in the store (name, signature label, bytes, mtime) plus
+    the aggregate state — the one-look answer to "what is warm".
+``gc [--max-bytes N]``
+    evict oldest-first down to the byte bound and print the post-GC
+    state (entries evicted, bytes reclaimed).
+``verify``
+    deep-check every artifact (magic, checksum, schema, fingerprint,
+    deserialize); defects are rejected on the spot exactly as a serving
+    load would reject them.  Exit 1 when anything was rejected.
+``warm --scenarios spec.json``
+    prepare the spec's scenarios (same validation + bucketing as the
+    fleet path), then AOT-compile each distinct executable from
+    abstract shapes only — no job runs, no device state mutates — and
+    write the serialized executables back.  A later
+    ``python -m cup3d_tpu fleet`` against the same store boots with
+    zero XLA compiles for these signatures.
+``probe --scenarios spec.json``
+    drain the spec exactly like the fleet CLI but report the
+    cold-start telemetry bench.py's ``cold_start`` config consumes:
+    seconds from process entry to the first dispatched advance, the
+    advance-executable compile count (analysis/runtime.py
+    RecompileCounter), the store hit/miss/write counters, and a
+    blake2s digest over every job's QoI rows (bitwise-equivalence
+    check between cold and warm runs).
+
+``--store PATH`` overrides ``CUP3D_AOT_STORE`` for any subcommand;
+``list``/``gc``/``verify`` require a store, ``warm``/``probe`` merely
+use one when configured (a store-less probe measures the pure cold
+baseline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+from typing import List, Optional
+
+from cup3d_tpu.obs import trace as OT
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m cup3d_tpu aot",
+        description="manage the persistent AOT executable store")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--store", default=None,
+                       help="store directory (default: CUP3D_AOT_STORE)")
+        return p
+
+    common(sub.add_parser("list", help="print store entries + state"))
+    gc = common(sub.add_parser(
+        "gc", help="evict oldest-first down to the byte bound"))
+    gc.add_argument("--max-bytes", type=int, default=None,
+                    help="byte bound (default: CUP3D_AOT_MAX_BYTES)")
+    common(sub.add_parser(
+        "verify", help="deep-check every artifact; exit 1 on defects"))
+
+    for name, hlp in (
+            ("warm", "AOT-compile a scenario spec's executables into "
+                     "the store without running any job"),
+            ("probe", "drain a scenario spec and print cold-start "
+                      "telemetry JSON")):
+        p = common(sub.add_parser(name, help=hlp))
+        p.add_argument("--scenarios", required=True,
+                       help="JSON spec: a list of scenarios or "
+                            '{"scenarios": [...], "lanes": N, '
+                            '"buckets": N}')
+        p.add_argument("--lanes", type=int, default=None,
+                       help="max lanes per batch (CUP3D_FLEET_LANES)")
+        p.add_argument("--buckets", type=int, default=None,
+                       help="executable cache cap (CUP3D_FLEET_BUCKETS)")
+        p.add_argument("--workdir", default=None,
+                       help="serialization dir (default: fresh tempdir)")
+    return ap
+
+
+def _resolve_store(args, required: bool):
+    """Honor ``--store`` (exported so every downstream
+    ``active_store()`` read — fleet seam included — sees it), then
+    return the active store or None."""
+    from cup3d_tpu.aot import store as aot_store
+
+    if args.store:
+        os.environ["CUP3D_AOT_STORE"] = args.store
+    st = aot_store.active_store()
+    if st is None and required:
+        raise SystemExit(
+            "no store: pass --store or set CUP3D_AOT_STORE")
+    return st
+
+
+def _load_spec(args):
+    with open(args.scenarios) as f:
+        spec = json.load(f)
+    if isinstance(spec, dict):
+        scenarios = spec.get("scenarios", [])
+        lanes = args.lanes if args.lanes is not None else spec.get("lanes")
+        buckets = (args.buckets if args.buckets is not None
+                   else spec.get("buckets"))
+    else:
+        scenarios, lanes, buckets = spec, args.lanes, args.buckets
+    if not scenarios:
+        raise SystemExit("no scenarios in spec")
+    return scenarios, lanes, buckets
+
+
+def _make_server(args):
+    from cup3d_tpu.fleet.server import FleetServer
+
+    scenarios, lanes, buckets = _load_spec(args)
+    server = FleetServer(max_lanes=lanes, max_buckets=buckets,
+                         workdir=args.workdir)
+    for i, sc in enumerate(scenarios):
+        server.submit(sc.get("tenant", f"tenant-{i}"), sc)
+    return server
+
+
+def cmd_list(args) -> int:
+    st = _resolve_store(args, required=True)
+    print(json.dumps({"state": st.state(), "entries": st.entries()},
+                     indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_gc(args) -> int:
+    st = _resolve_store(args, required=True)
+    before = st.state()
+    result = st.gc(max_bytes=args.max_bytes)
+    after = st.state()
+    print(json.dumps({
+        "gc": result,
+        "reclaimed_bytes": before["bytes"] - after["bytes"],
+        "state": after}, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_verify(args) -> int:
+    st = _resolve_store(args, required=True)
+    report = st.verify()
+    print(json.dumps({"report": report, "state": st.state()},
+                     indent=2, sort_keys=True))
+    return 1 if report["rejected"] else 0
+
+
+def cmd_warm(args) -> int:
+    """Compile-without-running: prepare every queued job, group by
+    bucket exactly as assembly would, and materialize each group's
+    executable from :func:`fleet.batch.abstract_advance_args` shapes.
+    Store-backed wrappers write the serialized executable back; repeat
+    runs load instead of compiling (``already_stored`` in the report).
+    """
+    from collections import OrderedDict
+
+    from cup3d_tpu.fleet import batch as FB
+    from cup3d_tpu.fleet.server import QUEUED, _lane_payload
+
+    _resolve_store(args, required=True)
+    server = _make_server(args)
+    buckets: "OrderedDict[tuple, list]" = OrderedDict()
+    for job in list(server._jobs.values()):
+        if job.status != QUEUED:
+            continue
+        prep = server._prepare(job)
+        if prep is None:
+            continue
+        kind, drv, sig, key = prep
+        buckets.setdefault(key, []).append((kind, job, drv))
+    warmed = []
+    for (sig, _rung), members in buckets.items():
+        kind, job, drv = members[0]
+        cap, K, mesh = server._batch_shape(members)
+        s = drv.sim
+        ob = s.obstacles[0] if kind == "fish" else None
+        fn = server.executable(sig, s, ob, cap, K, kind=kind, mesh=mesh)
+        entry = {"kind": kind, "jobs": len(members), "lanes": cap,
+                 "K": K, "sig": getattr(fn, "name", None)}
+        warm = getattr(fn, "warm", None)
+        if warm is None:  # store vanished between resolve and bind
+            entry["warmed"] = False
+        else:
+            store = fn.store
+            entry["already_stored"] = store.contains(fn.sig)
+            carry, gait = _lane_payload(kind, drv, job.job_id)
+            warm(*FB.abstract_advance_args(carry, gait, cap, K, s.dtype))
+            entry["warmed"] = store.contains(fn.sig)
+        warmed.append(entry)
+    st = _resolve_store(args, required=True)
+    print(json.dumps({"warmed": warmed, "state": st.state()},
+                     indent=2, sort_keys=True))
+    return 0 if all(e.get("warmed") for e in warmed) else 1
+
+
+def cmd_probe(args, t0: float) -> int:
+    from cup3d_tpu.analysis.runtime import RecompileCounter
+    from cup3d_tpu.obs import metrics as M
+
+    _resolve_store(args, required=False)
+    with RecompileCounter() as rc:
+        server = _make_server(args)
+        summary = server.drain()
+    dispatched = [t for t in (
+        j.event_time("dispatched") for j in server._jobs.values())
+        if t is not None]
+    digest = hashlib.blake2s()
+    for jid in sorted(server._jobs):
+        digest.update(jid.encode())
+        digest.update(server._jobs[jid].qoi_bytes())
+    snap = M.snapshot()
+    counters = {k: v for k, v in sorted(snap.items())
+                if k.startswith("aot.")}
+    # XLA compiles of the fleet advance, whichever path produced them:
+    # live jit tracing (RecompileCounter cache growth) or AOT
+    # lower().compile() (the aot.*compile_s histograms) — a warm store
+    # serves the executable without either firing
+    advance_compiles = sum(
+        n for name, n in rc.compiles.items() if "advance" in name)
+    # aot.compile_s observes the actual lower().compile() events;
+    # background_compile_s wraps the same builds and would double-count
+    advance_compiles += int(sum(
+        v for k, v in snap.items()
+        if k.startswith("aot.compile_s{")
+        and "advance" in k and k.endswith(".count")))
+    report = {
+        "first_dispatch_s": (min(dispatched) - t0 if dispatched
+                             else None),
+        "total_s": OT.now() - t0,
+        "advance_compiles": advance_compiles,
+        "total_compiles": rc.total_compiles,
+        "aot_counters": counters,
+        "rows_blake2s": digest.hexdigest(),
+        "jobs": {jid: server._jobs[jid].status
+                 for jid in sorted(server._jobs)},
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    bad = sum(st.get("failed", 0) for st in
+              (t["statuses"] for t in summary.values()))
+    return 1 if bad else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    # the probe's clock starts at CLI entry: cold-start includes every
+    # import + driver init + compile between exec and first dispatch
+    t0 = OT.now()
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    args = _build_parser().parse_args(argv)
+    if args.cmd == "list":
+        return cmd_list(args)
+    if args.cmd == "gc":
+        return cmd_gc(args)
+    if args.cmd == "verify":
+        return cmd_verify(args)
+    if args.cmd == "warm":
+        return cmd_warm(args)
+    return cmd_probe(args, t0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,527 @@
+"""On-disk, content-addressed store of serialized XLA executables
+(ISSUE 18 tentpole a).
+
+Every compiled executable in the system dies with its process, so a
+restarted server re-traces and re-compiles every signature before it
+can serve a job.  This module makes the compile cache durable:
+
+- **keying** — an entry's digest is ``blake2s(repr(sig) +
+  fingerprint)`` where ``sig`` is the caller's content signature (the
+  fleet's static bucket signature, the forest's octree signature +
+  config content) and the fingerprint pins jax/jaxlib versions, the
+  backend platform, the device topology (kinds + counts), ``XLA_FLAGS``
+  and the x64 mode.  A mismatched environment therefore hashes to a
+  DIFFERENT key: a stale artifact is a MISS, never a wrong load.
+- **format** — one file per executable: magic line, blake2s checksum
+  line, then a pickled record ``{schema, fingerprint, sig, name,
+  payload, in_tree, out_tree}`` where ``payload`` comes from
+  ``jax.experimental.serialize_executable.serialize``.  Loads verify
+  magic, checksum, schema, fingerprint AND the full ``repr(sig)``
+  (digest-collision guard) before ``deserialize_and_load``; any
+  failure is counted in ``aot.store_rejects{reason=...}``, the bad
+  file is removed, and the caller falls back to a live compile —
+  corruption NEVER crashes and NEVER yields a wrong executable.
+- **writes** — serialized through ``resilience/writeguard.atomic_write``
+  (tmp + ``os.replace`` + bounded retries): readers only ever see a
+  complete previous file or none.
+- **GC** — mtime-LRU bound to ``CUP3D_AOT_MAX_BYTES`` (default 2 GiB);
+  store hits ``os.utime`` their file so hot signatures survive.
+
+:class:`StoreBackedExecutable` is the seam object the caches hold: a
+lazy wrapper around a jitted callable that materializes its XLA
+executable on first use — store hit (zero traces, zero compiles) or
+live AOT compile + write-back — and transparently falls back to the
+plain jitted path whenever AOT is impossible on the current function
+or backend.
+
+jax imports are lazy: the store's list/gc/state surface (CLI, /health)
+works without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from cup3d_tpu.obs import metrics as M
+from cup3d_tpu.obs import trace as OT
+from cup3d_tpu.resilience import writeguard
+
+#: bump on any change to the record layout: old-schema entries become
+#: misses (rejected with reason="schema"), never misreads
+SCHEMA = 1
+
+MAGIC = b"CUP3DAOT1\n"
+
+SUFFIX = ".aotx"
+
+#: default GC bound (bytes) when CUP3D_AOT_MAX_BYTES is unset
+DEFAULT_MAX_BYTES = 2 << 30
+
+
+class StoreReject(Exception):
+    """One unloadable store entry; ``reason`` feeds the reject counter."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+# -- environment fingerprint -------------------------------------------------
+
+_FP_CACHE: Dict[int, dict] = {}
+
+
+def fingerprint() -> dict:
+    """Everything that makes a serialized executable valid to reload:
+    jax/jaxlib versions, backend platform, device topology (kinds +
+    local/global counts + process count), ``XLA_FLAGS`` and x64 mode.
+    The dict enters the store key (so mismatch = different digest) AND
+    every record (so a hand-copied file still can't load wrong).
+    Cached per process; never raises — a backend-less environment
+    fingerprints as ``platform="none"`` (such a process can't compile
+    anyway, so its entries can never shadow real ones)."""
+    cached = _FP_CACHE.get(0)
+    if cached is not None:
+        return dict(cached)
+    fp = {
+        "schema": SCHEMA,
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+    try:
+        import jax
+        import jaxlib
+
+        devices = jax.devices()
+        fp.update(
+            jax=str(jax.__version__),
+            jaxlib=str(jaxlib.__version__),
+            platform=str(devices[0].platform),
+            device_kinds=sorted({str(d.device_kind) for d in devices}),
+            device_count=int(jax.device_count()),
+            local_device_count=int(jax.local_device_count()),
+            process_count=int(jax.process_count()),
+            x64=bool(jax.config.jax_enable_x64),
+        )
+    except Exception:
+        M.counter("aot.fingerprint_unavailable").inc()
+        fp.update(jax="", jaxlib="", platform="none", device_kinds=[],
+                  device_count=0, local_device_count=0, process_count=0,
+                  x64=False)
+    _FP_CACHE[0] = fp
+    return dict(fp)
+
+
+def fingerprint_digest(fp: Optional[dict] = None) -> str:
+    fp = fingerprint() if fp is None else fp
+    blob = repr(sorted(fp.items())).encode()
+    return hashlib.blake2s(blob).hexdigest()
+
+
+def sig_digest(sig, fp: Optional[dict] = None) -> str:
+    """Content address of one (signature, environment) pair."""
+    blob = repr(sig).encode() + b"\0" + fingerprint_digest(fp).encode()
+    return hashlib.blake2s(blob).hexdigest()
+
+
+def sig_label(sig, n: int = 8) -> str:
+    """Short deterministic label for metrics/log lines (hash() is
+    per-process salted; this one survives restarts)."""
+    return hashlib.blake2s(repr(sig).encode()).hexdigest()[:n]
+
+
+# -- the store ---------------------------------------------------------------
+
+
+class ExecutableStore:
+    """One directory of ``<digest>.aotx`` entries (module doc)."""
+
+    def __init__(self, root: str, max_bytes: Optional[int] = None):
+        self.root = str(root)
+        if max_bytes is None:
+            try:
+                max_bytes = int(os.environ.get(
+                    "CUP3D_AOT_MAX_BYTES", DEFAULT_MAX_BYTES))
+            except ValueError:
+                max_bytes = DEFAULT_MAX_BYTES
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        os.makedirs(self.root, exist_ok=True)
+
+    def path_for(self, sig) -> str:
+        return os.path.join(self.root, sig_digest(sig) + SUFFIX)
+
+    def contains(self, sig) -> bool:
+        """Cheap presence probe (no load, no verification — a present
+        entry may still reject at :meth:`get` time)."""
+        return os.path.exists(self.path_for(sig))
+
+    # -- load ----------------------------------------------------------------
+
+    def _read_record(self, path: str) -> dict:
+        """Read + verify one entry file up to (not including) executable
+        deserialization; raises :class:`StoreReject` on any defect."""
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise StoreReject("io", str(e))
+        if not blob.startswith(MAGIC):
+            raise StoreReject("magic", path)
+        body = blob[len(MAGIC):]
+        nl = body.find(b"\n")
+        if nl < 0:
+            raise StoreReject("truncated", path)
+        checksum, inner = body[:nl], body[nl + 1:]
+        digest = hashlib.blake2s(inner).hexdigest().encode()
+        if checksum != digest:
+            raise StoreReject("checksum", path)
+        try:
+            rec = pickle.loads(inner)
+        except Exception as e:
+            raise StoreReject("unpickle", f"{path}: {e}")
+        if not isinstance(rec, dict) or rec.get("schema") != SCHEMA:
+            raise StoreReject("schema", path)
+        return rec
+
+    def _reject(self, path: str, reason: str) -> None:
+        M.counter("aot.store_rejects", reason=reason).inc()
+        try:
+            os.remove(path)
+        # jax-lint: allow(JX009, the rejection itself is already
+        # counted above; a racing unlink of an entry this process just
+        # refused to load changes nothing)
+        except OSError:
+            pass
+
+    def get(self, sig, name: str = "exec"):
+        """The deserialized, loaded executable for ``sig``, or None (a
+        miss — absent, or present-but-rejected).  Hits refresh the
+        entry's LRU clock."""
+        path = self.path_for(sig)
+        if not os.path.exists(path):
+            M.counter("aot.store_misses").inc()
+            return None
+        try:
+            rec = self._read_record(path)
+        except StoreReject as e:
+            self._reject(path, e.reason)
+            M.counter("aot.store_misses").inc()
+            return None
+        # the digest already encodes sig + fingerprint; re-checking the
+        # record guards digest collisions and hand-copied files
+        if rec.get("fingerprint") != fingerprint():
+            self._reject(path, "fingerprint")
+            M.counter("aot.store_misses").inc()
+            return None
+        if rec.get("sig") != repr(sig):
+            self._reject(path, "sig-collision")
+            M.counter("aot.store_misses").inc()
+            return None
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            compiled = deserialize_and_load(
+                rec["payload"], rec["in_tree"], rec["out_tree"])
+        except Exception:
+            self._reject(path, "deserialize")
+            M.counter("aot.store_misses").inc()
+            return None
+        try:
+            os.utime(path)
+        # jax-lint: allow(JX009, the LRU-clock refresh is best-effort:
+        # a failed utime only ages this entry toward eviction — the
+        # hit itself is counted right below)
+        except OSError:
+            pass
+        M.counter("aot.store_hits").inc()
+        return compiled
+
+    # -- write ---------------------------------------------------------------
+
+    def put(self, sig, compiled, name: str = "exec") -> Optional[str]:
+        """Serialize ``compiled`` and write it under ``sig``'s digest
+        (atomic; GC'd to the size bound after).  Returns the path, or
+        None when the executable can't serialize / the disk won't
+        cooperate — both counted, never raised."""
+        try:
+            from jax.experimental.serialize_executable import serialize
+
+            payload, in_tree, out_tree = serialize(compiled)
+        except Exception:
+            M.counter("aot.store_write_failures",
+                      reason="serialize").inc()
+            return None
+        rec = {"schema": SCHEMA, "fingerprint": fingerprint(),
+               "sig": repr(sig), "name": str(name), "payload": payload,
+               "in_tree": in_tree, "out_tree": out_tree}
+        try:
+            inner = pickle.dumps(rec, protocol=4)
+        except Exception:
+            M.counter("aot.store_write_failures", reason="pickle").inc()
+            return None
+        blob = MAGIC + hashlib.blake2s(inner).hexdigest().encode() \
+            + b"\n" + inner
+        path = self.path_for(sig)
+
+        def write(tmp: str) -> None:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+
+        try:
+            with self._lock:
+                writeguard.atomic_write(path, write, site="aot-store")
+        except Exception:
+            M.counter("aot.store_write_failures", reason="io").inc()
+            return None
+        M.counter("aot.store_writes").inc()
+        self.gc()
+        return path
+
+    # -- inventory / GC ------------------------------------------------------
+
+    def _files(self) -> List[Tuple[str, int, float]]:
+        """[(path, bytes, mtime)] of every entry, oldest first."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for fname in names:
+            if not fname.endswith(SUFFIX):
+                continue
+            path = os.path.join(self.root, fname)
+            try:
+                st = os.stat(path)
+            # jax-lint: allow(JX009, inventory races with concurrent
+            # GC/rejection by design: an entry unlinked between listdir
+            # and stat has simply left the store)
+            except OSError:
+                continue
+            out.append((path, int(st.st_size), float(st.st_mtime)))
+        out.sort(key=lambda e: e[2])
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(size for _, size, _ in self._files())
+
+    def gc(self, max_bytes: Optional[int] = None) -> dict:
+        """Evict oldest-touched entries until the store fits the byte
+        bound.  Returns {evicted, bytes, files}."""
+        bound = self.max_bytes if max_bytes is None else int(max_bytes)
+        evicted = 0
+        with self._lock:
+            files = self._files()
+            total = sum(size for _, size, _ in files)
+            for path, size, _ in files:
+                if total <= bound:
+                    break
+                try:
+                    os.remove(path)
+                # jax-lint: allow(JX009, a concurrently-removed entry
+                # no longer occupies bytes; the next pass recounts and
+                # every successful eviction is counted below)
+                except OSError:
+                    continue
+                total -= size
+                evicted += 1
+                M.counter("aot.store_gc_evictions").inc()
+        M.gauge("aot.store_bytes").set(float(total))
+        return {"evicted": evicted, "bytes": total,
+                "files": len(files) - evicted}
+
+    def entries(self) -> List[dict]:
+        """Metadata of every loadable-looking entry (record header, not
+        the executable): [{digest, name, sig, bytes, mtime}]."""
+        out = []
+        for path, size, mtime in self._files():
+            digest = os.path.basename(path)[:-len(SUFFIX)]
+            try:
+                rec = self._read_record(path)
+            except StoreReject as e:
+                out.append({"digest": digest, "bytes": size,
+                            "mtime": mtime, "defect": e.reason})
+                continue
+            out.append({"digest": digest, "name": rec.get("name"),
+                        "sig": rec.get("sig"), "bytes": size,
+                        "mtime": mtime})
+        return out
+
+    def verify(self) -> dict:
+        """Deep check: every entry must read, checksum AND deserialize.
+        Defective entries are rejected (counted + removed), like a
+        failed :meth:`get`.  Returns {ok, rejected, reasons}."""
+        ok, rejected, reasons = 0, 0, {}
+        for path, _, _ in self._files():
+            try:
+                rec = self._read_record(path)
+                if rec.get("fingerprint") != fingerprint():
+                    raise StoreReject("fingerprint", path)
+                from jax.experimental.serialize_executable import (
+                    deserialize_and_load,
+                )
+
+                deserialize_and_load(
+                    rec["payload"], rec["in_tree"], rec["out_tree"])
+                ok += 1
+            except StoreReject as e:
+                self._reject(path, e.reason)
+                rejected += 1
+                reasons[e.reason] = reasons.get(e.reason, 0) + 1
+            except Exception:
+                self._reject(path, "deserialize")
+                rejected += 1
+                reasons["deserialize"] = reasons.get("deserialize", 0) + 1
+        return {"ok": ok, "rejected": rejected, "reasons": reasons}
+
+    def state(self) -> dict:
+        """The /health payload: root, bound, inventory size."""
+        files = self._files()
+        return {
+            "root": self.root,
+            "max_bytes": self.max_bytes,
+            "files": len(files),
+            "bytes": sum(size for _, size, _ in files),
+        }
+
+
+# -- the active store (CUP3D_AOT_STORE) --------------------------------------
+
+_STORES: Dict[str, ExecutableStore] = {}
+_STORES_LOCK = threading.Lock()
+
+
+def active_store() -> Optional[ExecutableStore]:
+    """The process's persistent store, or None when ``CUP3D_AOT_STORE``
+    is unset/empty (the default: every seam stays exactly as before)."""
+    root = os.environ.get("CUP3D_AOT_STORE", "")
+    if not root:
+        return None
+    with _STORES_LOCK:
+        st = _STORES.get(root)
+        if st is None:
+            st = _STORES[root] = ExecutableStore(root)
+        return st
+
+
+# -- the seam object ---------------------------------------------------------
+
+
+class StoreBackedExecutable:
+    """Lazy store-backed twin of a jitted callable (module doc).
+
+    States: fresh (nothing materialized), AOT (``_compiled`` holds the
+    XLA executable — store hit or live ``lower().compile()`` + write-
+    back), or fallback (``_fallback``: AOT impossible here — e.g. the
+    function doesn't lower on this backend — so every call takes the
+    plain jitted path, exactly the pre-store behavior).  A store hit
+    never traces and never compiles: that is the zero-cold-start
+    contract the warm-boot test pins with a RecompileCounter.
+
+    ``donated`` marks executables whose call consumes input buffers:
+    for those a failing AOT call re-raises instead of retrying on the
+    jitted path (the operands may already be donated away)."""
+
+    def __init__(self, jitted, sig, name: str = "exec",
+                 store: Optional[ExecutableStore] = None,
+                 donated: bool = False):
+        self._jitted = jitted
+        self.sig = sig
+        self.name = str(name)
+        self.store = store
+        self.donated = bool(donated)
+        self._compiled = None
+        self._fallback = False
+        self._lock = threading.Lock()
+        self.__name__ = getattr(jitted, "__name__", self.name)
+
+    @property
+    def jitted(self):
+        """The underlying jitted callable (the fallback path)."""
+        return self._jitted
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def aot_compiled(self):
+        """The materialized XLA executable, or None."""
+        return self._compiled
+
+    def materialized(self) -> bool:
+        return self._compiled is not None or self._fallback
+
+    def _materialize(self, args, kwargs) -> None:
+        with self._lock:
+            if self._compiled is not None or self._fallback:
+                return
+            if self.store is not None:
+                hit = self.store.get(self.sig, name=self.name)
+                if hit is not None:
+                    self._compiled = hit
+                    return
+            t0 = OT.now()
+            try:
+                compiled = self._jitted.lower(*args, **kwargs).compile()
+            except Exception:
+                # function/backend can't AOT here (e.g. non-lowerable
+                # operands): permanently take the plain jitted path
+                M.counter("aot.compile_fallbacks", executable=self.name).inc()
+                self._fallback = True
+                return
+            M.histogram("aot.compile_s",
+                        executable=self.name).observe(OT.now() - t0)
+            self._compiled = compiled
+            if self.store is not None:
+                self.store.put(self.sig, compiled, name=self.name)
+
+    def warm(self, *avals, **kwargs) -> bool:
+        """Materialize without executing — ``avals`` may be
+        ``jax.ShapeDtypeStruct``s (lowering never touches data), which
+        is what the background compile service passes.  True when an
+        XLA executable is now held."""
+        self._materialize(avals, kwargs)
+        return self._compiled is not None
+
+    def ensure_compiled(self, *args, **kwargs):
+        """Materialize on live operands and return the XLA executable
+        (None in fallback state).  ``obs/costs.py`` routes its harvest
+        through this instead of re-lower-and-compiling a twin."""
+        self._materialize(args, kwargs)
+        return self._compiled
+
+    def __call__(self, *args, **kwargs):
+        if self._fallback:
+            return self._jitted(*args, **kwargs)
+        if self._compiled is None:
+            self._materialize(args, kwargs)
+            if self._compiled is None:
+                return self._jitted(*args, **kwargs)
+        try:
+            return self._compiled(*args, **kwargs)
+        except Exception:
+            M.counter("aot.call_fallbacks", executable=self.name).inc()
+            if self.donated:
+                # inputs may be consumed: a retry would read deleted
+                # buffers — surface the real failure instead
+                raise
+            self._fallback = True
+            return self._jitted(*args, **kwargs)
+
+
+def store_backed(jitted, sig, name: Optional[str] = None,
+                 store: Optional[ExecutableStore] = None,
+                 donated: bool = False):
+    """Wrap ``jitted`` for the active store; with no store configured
+    this returns ``jitted`` unchanged (the zero-overhead default)."""
+    if store is None:
+        store = active_store()
+    if store is None:
+        return jitted
+    label = name or getattr(jitted, "__name__", None) or "exec"
+    return StoreBackedExecutable(jitted, sig, name=label, store=store,
+                                 donated=donated)

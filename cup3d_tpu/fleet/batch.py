@@ -109,6 +109,35 @@ def stack_carries(carries, targets):
     return out
 
 
+def abstract_advance_args(carry, gait, B, K, dtype):
+    """The ``jax.ShapeDtypeStruct`` avals of one batched advance call
+    — exactly the shapes stack_carries / _cfl_block / stack_gaits
+    produce for ``B`` lanes and ``K`` steps — from a SINGLE lane's
+    solo (carry, gait) payload.  This is what the background compile
+    service (aot/compiler.py) lowers against: no batched arrays are
+    materialized, no device memory is touched, and the resulting AOT
+    executable is bit-for-bit the one a live dispatch would build.
+    Returns ``(carry_avals, cfl_aval, gaits_avals_or_None)``."""
+    sds = jax.ShapeDtypeStruct
+
+    def batched(v):
+        leaf = jnp.asarray(v) if not hasattr(v, "shape") else v
+        return sds((int(B),) + tuple(leaf.shape), leaf.dtype)
+
+    carry_avals = {k: batched(v) for k, v in carry.items()}
+    carry_avals[LEFT] = sds((int(B),), jnp.int32)
+    cfl_aval = sds((int(B), int(K)), np.dtype(dtype))
+    gaits_avals = None
+    if gait is not None:
+        # mirror stack_gaits: every leaf is cast to the sim dtype and
+        # stacked along a new lane axis (floats become (B,) scalars)
+        gaits_avals = {
+            k: sds((int(B),) + tuple(np.shape(v)), np.dtype(dtype))
+            for k, v in gait.items()
+        }
+    return carry_avals, cfl_aval, gaits_avals
+
+
 def _gated(core, has_gait):
     """Wrap a solo scan body with the per-lane freeze gate.  Inside vmap
     each lane sees scalar ``left``; a finished/retired/padding lane

@@ -812,7 +812,176 @@ class FleetServer:
         #: sig, bucket key) — a job waiting for a compatible lane is
         #: not re-inited at every K-boundary
         self._prepared: Dict[str, tuple] = {}
+        #: round 21 — background compile service (aot/compiler.py),
+        #: created lazily iff the persistent store is active; with
+        #: CUP3D_AOT_STORE unset the whole AOT path is inert
+        self._aot_service = None
         _LIVE.append(weakref.ref(self))
+
+    # -- AOT store / background compile (round 21) -------------------------
+
+    def _aot(self):
+        """(store, service) when ``CUP3D_AOT_STORE`` is set, else
+        (None, None): the whole zero-cold-start machinery keys off the
+        active store."""
+        from cup3d_tpu.aot import store as aot_store
+
+        st = aot_store.active_store()
+        if st is None:
+            return None, None
+        if self._aot_service is None:
+            from cup3d_tpu.aot.compiler import CompileService
+
+            self._aot_service = CompileService()
+        return st, self._aot_service
+
+    @staticmethod
+    def _mesh_key(mesh):
+        return tuple(mesh.shape.items()) if mesh is not None else None
+
+    @staticmethod
+    def _store_sig(sig: tuple, cap: int, K: int, mesh_key) -> tuple:
+        """The cross-process store key for one fleet advance: the
+        content-addressed static signature plus the shapes that enter
+        the compiled executable (lane rung, scan K, mesh layout)."""
+        return ("fleet.advance", sig, int(cap), int(K), mesh_key)
+
+    def _bind_advance(self, s, ob, cap: int, K: int, kind, mesh,
+                      sig: tuple, store):
+        """Build the vmapped advance and, with a store active, wrap it
+        store-backed: first use loads the serialized executable (zero
+        compiles) or AOT-compiles and writes back."""
+        fn = FB.build_fleet_advance(s, ob, mesh=mesh, kind=kind)
+        if store is not None:
+            from cup3d_tpu.aot import store as aot_store
+
+            skey = self._store_sig(sig, cap, K, self._mesh_key(mesh))
+            fn = aot_store.StoreBackedExecutable(
+                fn, skey,
+                name=f"fleet.advance-{aot_store.sig_label(skey)}",
+                store=store)
+        return fn
+
+    def _background_key(self, sig: tuple, cap: int, K: int, mesh):
+        return (sig, int(cap), int(K), self._mesh_key(mesh))
+
+    def _batch_shape(self, members) -> Tuple[int, int, object]:
+        """(cap, K, mesh) the assembly of ``members`` will use — must
+        mirror _build_batches so background-compiled executables land
+        on the exact LRU key assembly asks for."""
+        cap = self.lane_capacity(len(members))
+        K = resolve_scan_k(members[0][2].cfg)
+        if K <= 1:
+            K = DEFAULT_SCAN_K
+        mesh = FB.resolve_fleet_mesh(cap, self.mesh)
+        return cap, K, mesh
+
+    def _maybe_background_compile(self, leftovers):
+        """Split fresh-assembly groups into assemble-now vs wait-for-
+        compile.  With the service active, a group whose executable is
+        neither LRU-cached nor in the store is submitted as a
+        background build and its jobs stay QUEUED (preps cached) —
+        the dispatch thread keeps serving warm signatures meanwhile.
+        Returns the groups to assemble on this pass."""
+        st, svc = self._aot()
+        if svc is None:
+            return leftovers
+        ready: "OrderedDict[tuple, list]" = OrderedDict()
+        for key, members in leftovers.items():
+            sig = key[0]
+            kind, job, drv = members[0]
+            cap, K, mesh = self._batch_shape(members)
+            ekey = self._background_key(sig, cap, K, mesh)
+            if ekey in self._execs:
+                ready[key] = members
+                continue
+            status = svc.status(ekey)
+            if status == "done":
+                fn = svc.take(ekey)
+                if fn is not None:
+                    self._execs[ekey] = fn
+                    M.counter("aot.background_installs").inc()
+                ready[key] = members
+                continue
+            if status in ("pending", "running"):
+                for kind_m, job_m, drv_m in members:
+                    self._prepared[job_m.job_id] = (
+                        kind_m, drv_m, sig, key)
+                continue
+            if status == "failed" or st.contains(
+                    self._store_sig(sig, cap, K, self._mesh_key(mesh))):
+                # failed background build -> synchronous fallback;
+                # store present -> assembling now is a disk read
+                ready[key] = members
+                continue
+            self._submit_background(svc, st, sig, cap, K, kind, mesh,
+                                    drv, job, members, ekey, key)
+        return ready
+
+    def _submit_background(self, svc, st, sig, cap, K, kind, mesh,
+                           drv, job, members, ekey, bucket_key) -> None:
+        """Queue one demand build (plus the speculative ±1 ladder
+        rungs) and park the group's jobs as prepared-but-waiting."""
+        from cup3d_tpu.aot import compiler as aot_compiler
+
+        s = drv.sim
+        ob = s.obstacles[0] if kind == "fish" else None
+        carry, gait = _lane_payload(kind, drv, job.job_id)
+        label = "fleet.advance-" + hashlib.blake2s(
+            repr(sig).encode()).hexdigest()[:8]
+
+        def demand_build(cap=cap, K=K, mesh=mesh):
+            fn = self._bind_advance(s, ob, cap, K, kind, mesh, sig, st)
+            avals = FB.abstract_advance_args(
+                carry, gait, cap, K, s.dtype)
+            warm = getattr(fn, "warm", None)
+            if warm is not None:
+                warm(*avals)
+            return fn
+
+        svc.submit(ekey, demand_build, name=label,
+                   priority=aot_compiler.PRIORITY_DEMAND)
+        for kind_m, job_m, drv_m in members:
+            self._prepared[job_m.job_id] = (kind_m, drv_m, sig,
+                                            bucket_key)
+        if not aot_compiler.speculate_enabled():
+            return
+        for rung in self._neighbor_rungs(cap):
+            rkey = self._background_key(sig, rung, K, mesh)
+            if rkey in self._execs or svc.status(rkey) is not None:
+                continue
+
+            def spec_build(rung=rung, K=K, mesh=mesh):
+                fn = self._bind_advance(s, ob, rung, K, kind, mesh,
+                                        sig, st)
+                avals = FB.abstract_advance_args(
+                    carry, gait, rung, K, s.dtype)
+                warm = getattr(fn, "warm", None)
+                if warm is not None:
+                    warm(*avals)
+                return fn
+
+            if svc.submit(rkey, spec_build, name=label,
+                          priority=aot_compiler.PRIORITY_SPECULATIVE):
+                M.counter("aot.speculative_compiles").inc()
+
+    def _neighbor_rungs(self, cap: int) -> List[int]:
+        """The ±1 rungs of the ×1.25 lane ladder around ``cap``
+        (mesh-rounded, max-lanes-clamped, deduplicated)."""
+        rungs = []
+        down = None
+        c = LANE_LADDER_BASE
+        while c < cap:
+            down = c
+            c = max(c + 1, int(np.ceil(c * 1.25)))
+        if down is not None:
+            down = self.lane_capacity(down)
+            if 0 < down != cap:
+                rungs.append(down)
+        up = self.lane_capacity(cap + 1)
+        if cap < up <= self.max_lanes and up not in rungs:
+            rungs.append(up)
+        return rungs
 
     # -- tenant lifecycle --------------------------------------------------
 
@@ -898,6 +1067,7 @@ class FleetServer:
                 b.tick()
         for b in self.batches:
             b.settle()
+        self._aot_quiesce()
         self._close_occupancy_window(busy0, total0)
         self.update_lane_gauge()
         return self.tenant_summary()
@@ -930,13 +1100,29 @@ class FleetServer:
             tick += 1
             queued = any(
                 j.status == QUEUED for j in self._jobs.values())
+            if (not live and queued and self._aot_service is not None
+                    and self._aot_service.depth() > 0):
+                # every queued job waits on a background compile and
+                # nothing is dispatchable: park on the service instead
+                # of busy-spinning the scheduler
+                self._aot_service.wait(0.05)
             if not admitting and not live and not queued:
                 break
         for b in self.batches:
             b.settle()
+        self._aot_quiesce()
         self._close_occupancy_window(busy0, total0)
         self.update_lane_gauge()
         return self.tenant_summary()
+
+    def _aot_quiesce(self) -> None:
+        """Let in-flight background builds finish before the serve/drain
+        window closes: speculative executables land in the store (warm
+        for the next boot), and the process never exits mid-XLA-compile
+        (a daemon thread inside the compiler at interpreter teardown
+        aborts the process)."""
+        if self._aot_service is not None:
+            self._aot_service.drain(timeout=600.0)
 
     def queue_depth(self) -> int:
         return sum(1 for j in self._jobs.values() if j.status == QUEUED)
@@ -1090,6 +1276,11 @@ class FleetServer:
                     self._prepared.pop(job.job_id, None)
                 leftovers.setdefault(key, []).extend(members)
         if leftovers:
+            # round 21: cold signatures may compile off-thread — the
+            # service keeps their jobs queued and this pass assembles
+            # only what is warm (LRU, store, or finished build)
+            leftovers = self._maybe_background_compile(leftovers)
+        if leftovers:
             self._build_batches(leftovers)
         if reseeded or leftovers:
             self.update_lane_gauge()
@@ -1098,15 +1289,23 @@ class FleetServer:
     def executable(self, sig: tuple, s, ob, cap: int, K: int,
                    kind: Optional[str] = None, mesh=None):
         """The compiled-advance cache, LRU-capped by the buckets knob:
-        one vmapped executable per (signature, lane rung, K, mesh)."""
-        mesh_key = (tuple(mesh.shape.items()) if mesh is not None else None)
-        key = (sig, int(cap), int(K), mesh_key)
+        one vmapped executable per (signature, lane rung, K, mesh).
+        Round 21: with ``CUP3D_AOT_STORE`` set a miss first consults
+        the background compile service, then binds a store-backed
+        executable — a previously-seen signature loads its serialized
+        XLA executable instead of compiling (zero-cold-start boot)."""
+        key = (sig, int(cap), int(K), self._mesh_key(mesh))
         hit = self._execs.pop(key, None)
         if hit is not None:
             self._execs[key] = hit
             M.counter("fleet.executable_hits").inc()
             return hit
-        fn = FB.build_fleet_advance(s, ob, mesh=mesh, kind=kind)
+        st, svc = self._aot()
+        fn = svc.take(key) if svc is not None else None
+        if fn is not None:
+            M.counter("aot.background_installs").inc()
+        else:
+            fn = self._bind_advance(s, ob, cap, K, kind, mesh, sig, st)
         self._execs[key] = fn
         M.counter("fleet.executable_builds").inc()
         while len(self._execs) > self.max_buckets:
@@ -1283,6 +1482,19 @@ class FleetServer:
             raise ValueError(f"{job_id} was never assembled into a batch")
         return job.batch.lane_state(job.lane)
 
+    def _aot_health(self) -> Optional[dict]:
+        """Store + compile-service state, or None when inert."""
+        from cup3d_tpu.aot import store as aot_store
+
+        st = aot_store.active_store()
+        if st is None and self._aot_service is None:
+            return None
+        return {
+            "store": st.state() if st is not None else None,
+            "service": (self._aot_service.state()
+                        if self._aot_service is not None else None),
+        }
+
     def health(self) -> dict:
         """Fleet state for the obs /health endpoint."""
         depth = self.queue_depth()
@@ -1294,6 +1506,7 @@ class FleetServer:
             "dispatches": int(sum(b.dispatches for b in self.batches)),
             "rollbacks": int(sum(b.guard.rollbacks for b in self.batches)),
             "executables": len(self._execs),
+            "aot": self._aot_health(),
             "slo": self.slo_status(),
             "admission": {
                 "queue_depth": depth,

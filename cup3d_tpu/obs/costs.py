@@ -218,7 +218,22 @@ def analyze_jitted(name: str, jitted, *args, **kwargs) -> Optional[dict]:
     executable's cost row.  Off the hot path by design: lowering
     executes nothing (no device sync, no donation — safe on functions
     with ``donate_argnums``), compiling costs one compile.  Returns
-    None (counted) when the backend can't lower/compile here."""
+    None (counted) when the backend can't lower/compile here.
+
+    Round 21 fix: a store-backed executable (aot/store.py) already
+    holds — or knows how to load — its compiled object; harvesting
+    through ``ensure_compiled`` reuses it instead of paying a
+    duplicate lower+compile of a twin."""
+    ensure = getattr(jitted, "ensure_compiled", None)
+    if ensure is not None:
+        try:
+            compiled = ensure(*args, **kwargs)
+        except Exception:
+            compiled = None
+        if compiled is not None:
+            return harvest_compiled(name, compiled)
+        # fallback state: harvest the plain jitted twin below
+        jitted = getattr(jitted, "jitted", jitted)
     try:
         compiled = jitted.lower(*args, **kwargs).compile()
     except Exception:
@@ -246,7 +261,12 @@ def harvest_on_first_call(jitted, name: str):
     call).  Used by the forest/fleet bind points when
     :func:`enabled`; the steady-state path after the first call is the
     raw jitted function (the wrapper uninstalls itself logically via a
-    flag — one bool test per call, no device work ever)."""
+    flag — one bool test per call, no device work ever).
+
+    The harvest runs BEFORE the wrapped call (lowering never donates,
+    so the operands are still live); for a store-backed executable
+    :func:`analyze_jitted` routes through its already-materialized
+    compiled object, so the first call pays zero extra compiles."""
     state = {"done": False}
 
     def wrapper(*args, **kwargs):

@@ -241,7 +241,7 @@ def health_payload() -> dict:
         })
     counters = {k: v for k, v in snap.items()
                 if k.startswith(("flight.", "resilience.", "recovery.",
-                                 "fleet."))}
+                                 "fleet.", "aot."))}
     # live fleet servers (weakref registry, same pattern as the flight
     # recorders); the lazy import keeps obs importable standalone
     from cup3d_tpu.fleet.server import live_servers as _fleet_live
@@ -249,12 +249,19 @@ def health_payload() -> dict:
     fleet = [srv.health() for srv in _fleet_live()]
     from cup3d_tpu.obs import federate as _federate
 
+    # round 21: persistent AOT executable store state (None when
+    # CUP3D_AOT_STORE is unset; the lazy import keeps obs import-light)
+    from cup3d_tpu.aot import store as _aot_store
+
+    aot_st = _aot_store.active_store()
+
     return {
         "status": "ok",
         "time": time.time(),
         "flight_recorders": flights,
         "recovery_counters": counters,
         "fleet": fleet,
+        "aot": {"store": aot_st.state() if aot_st is not None else None},
         "trace": {"enabled": _trace.TRACE.enabled,
                   "steps_recorded": _trace.TRACE.steps_recorded,
                   "steps_dropped": _trace.TRACE.steps_dropped},

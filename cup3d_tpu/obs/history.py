@@ -122,6 +122,17 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("detail", "roofline", "legacy", "compiler", "bytes_per_iter")),
         higher_is_better=False,
     ),
+    # round 21 (zero cold start): boot-to-first-dispatch of a fresh
+    # process against a WARMED executable store (bench.py cold_start,
+    # subprocess-measured).  A rise means boot started recompiling —
+    # the store stopped serving (fingerprint churn, key drift, a new
+    # compile on the admission path); lower is better
+    MetricSpec(
+        "warm_start_s",
+        (("cold_start", "warm_start_s"),
+         ("detail", "warm_start_s")),
+        higher_is_better=False,
+    ),
 )
 
 

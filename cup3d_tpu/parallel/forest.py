@@ -142,7 +142,8 @@ class ExecutableMemo:
             self._memo.popitem(last=False)
 
 
-def bind_step_executable(fn, *bound, donate=(), name=None):
+def bind_step_executable(fn, *bound, donate=(), name=None,
+                         store_sig=None):
     """One compiled step executable with the forest's (non-pytree)
     tables closed over as trailing constants: ``fn(*args, *bound)``
     jitted with ``donate`` naming the caller-facing state argnums.
@@ -159,18 +160,32 @@ def bind_step_executable(fn, *bound, donate=(), name=None):
     counted FLOPs/bytes/HBM footprint into the obs registry under
     ``name`` (default: the wrapped fn's name).  One extra lowering per
     bound executable, a single cached bool test per call after that —
-    the steady-state hot path is untouched."""
+    the steady-state hot path is untouched.
+
+    Round 21: and THE persistence seam — ``store_sig`` (the octree
+    signature plus the config content the closure captures; equal sigs
+    guarantee bitwise-equal bound tables) keys the executable into the
+    persistent AOT store when ``CUP3D_AOT_STORE`` is active, so a
+    restarted process loads the serialized executable instead of
+    retracing.  With the store inactive or ``store_sig=None`` the
+    returned object is the plain jitted callable, unchanged."""
     jitted = jax.jit(lambda *a: fn(*a, *bound), donate_argnums=donate)
+    label = name or getattr(fn, "__name__", None) or "forest.step"
+    if store_sig is not None:
+        from cup3d_tpu.aot import store as aot_store
+
+        jitted = aot_store.store_backed(
+            jitted, ("forest", label, tuple(donate), store_sig),
+            name=f"forest.{label}", donated=bool(donate))
     from cup3d_tpu.obs import costs as obs_costs
 
     if obs_costs.enabled():
-        label = name or getattr(fn, "__name__", None) or "forest.step"
         jitted = obs_costs.harvest_on_first_call(
             jitted, f"forest.{label}")
     return jitted
 
 
-def bind_order_executables(fn, tabs, donate=()) -> tuple:
+def bind_order_executables(fn, tabs, donate=(), store_sig=None) -> tuple:
     """(first_order, second_order) compiled executables for a pressure-
     order-switched step body: ``fn(*args, *tabs, second_order=...)``
     bound per order through :func:`bind_step_executable`.  The caller
@@ -180,7 +195,8 @@ def bind_order_executables(fn, tabs, donate=()) -> tuple:
         bind_step_executable(partial(fn, second_order=so), *tabs,
                              donate=donate,
                              name=f"{getattr(fn, '__name__', 'step')}"
-                                  f"_o{2 if so else 1}")
+                                  f"_o{2 if so else 1}",
+                             store_sig=store_sig)
         for so in (False, True)
     )
 

@@ -382,6 +382,32 @@ class AMRSimulation:
 
     # -- jitted kernels (rebuilt per layout) -------------------------------
 
+    def _aot_content_sig(self, octree_sig) -> tuple:
+        """The persistent-store content key of this layout's forest
+        executables (round 21): the octree signature plus every config
+        knob the closures capture (tolerances, nu, dtype, extent, mesh
+        layout).  Equal keys guarantee bitwise-equal bound tables (the
+        ExecutableMemo contract), so a store hit is exact; anything
+        that changes the compiled body changes the key."""
+        cfg = self.cfg
+        return (
+            octree_sig,
+            int(self.grid.bs),
+            str(np.dtype(self.dtype)),
+            float(self.nu),
+            tuple(float(v) for v in self.grid.extent),
+            float(cfg.poissonTol),
+            float(cfg.poissonTolRel),
+            bool(cfg.bMeanConstraint),
+            bool(cfg.implicitDiffusion),
+            float(cfg.diffusionTol),
+            float(cfg.diffusionTolRel),
+            bool(cfg.bFixMassFlux),
+            int(cfg.step_2nd_start),
+            (tuple(self.mesh.shape.items())
+             if self.mesh is not None else None),
+        )
+
     def _rebuild(self):
         if self.mesh is None and self._bucketing:
             return self._rebuild_bucketed()
@@ -457,7 +483,14 @@ class AMRSimulation:
         # re-layout.  The sharded forest's duck-typed tables are not
         # pytrees, so that path keeps the closure style (its scale is
         # bounded by per-device shards anyway).
-        def jit_bound(fn, *bound, donate=()):
+        # round 21: forest-bound executables persist in the AOT store
+        # under (octree signature + closure-content) keys — equal keys
+        # guarantee bitwise-equal bound tables, so a restarted process
+        # reloads the serialized executable instead of retracing
+        aot_sig = (self._aot_content_sig(g.signature)
+                   if self.mesh is not None else None)
+
+        def jit_bound(fn, *bound, donate=(), name=None):
             # donate: positional argnums of the CALLER-facing signature
             # (the bound tables sit after them, so the numbers agree on
             # both paths).  Donated args are the step state buffers the
@@ -470,7 +503,8 @@ class AMRSimulation:
                 # the regrid ping-pong — the JX007 burn-down)
                 from cup3d_tpu.parallel.forest import bind_step_executable
 
-                return bind_step_executable(fn, *bound, donate=donate)
+                return bind_step_executable(fn, *bound, donate=donate,
+                                            name=name, store_sig=aot_sig)
             # jax-lint: allow(JX007, legacy CUP3D_BUCKET=0 path kept as
             # the bucketing equivalence baseline (tests/test_bucketing);
             # production single-device runs use _rebuild_bucketed)
@@ -495,7 +529,7 @@ class AMRSimulation:
                     ),
                 ),
                 self._tab3, self._tab1, self._ftab,
-                donate=(0,),  # vel -> vel
+                donate=(0,), name="advdiff_imp",
             )
         else:
             self._advdiff = jit_bound(
@@ -503,7 +537,7 @@ class AMRSimulation:
                     geom, vel, dt, self.nu, uinf, tab3, ftab
                 ),
                 self._tab3, self._ftab,
-                donate=(0,),  # vel -> vel
+                donate=(0,), name="advdiff",
             )
         # with_stats: (vel, p, [resid, iters]) — the stats vector joins
         # the end-of-step packed QoI read (zeros on the stats-less
@@ -515,7 +549,7 @@ class AMRSimulation:
                 p_init=p_old, with_stats=True,
             ),
             self._tab1, self._ftab,
-            donate=(0, 4),  # vel -> vel, p_old -> p; chi/udef persist
+            donate=(0, 4), name="project",
         )
         self._project_2nd = jit_bound(
             lambda vel, dt, chi, udef, p_old, tab1, ftab:
@@ -524,13 +558,13 @@ class AMRSimulation:
                 p_init=p_old, second_order=True, with_stats=True,
             ),
             self._tab1, self._ftab,
-            donate=(0, 4),  # vel -> vel, p_old -> p; chi/udef persist
+            donate=(0, 4), name="project_2nd",
         )
         self._penalize = _penalize_j
         self._penal_force = jit_bound(
             lambda vn, vo, chis, dt, cms, vol, xc:
             per_obstacle_penalization_force(vn, vo, chis, dt, vol, xc, cms),
-            self._vol, self._xc,
+            self._vol, self._xc, name="penal_force",
         )
         # ALL obstacles' force QoI in one (n_obs, FORCE_PACK) host read per
         # step
@@ -541,23 +575,23 @@ class AMRSimulation:
             lambda udef, cm, ut, om, xc: ut
             + jnp.cross(jnp.broadcast_to(om, xc.shape), xc - cm)
             + udef,
-            self._xc,
+            self._xc, name="ubody",
         )
         self._divnorms = jit_bound(
             lambda vel, tab1: amr_ops.divergence_norms_blocks(geom, vel, tab1),
-            self._tab1,
+            self._tab1, name="divnorms",
         )
         self._dissipation = jit_bound(
             lambda vel, tab1: amr_ops.dissipation_blocks(
                 geom, vel, self.nu, tab1
             ),
-            self._tab1,
+            self._tab1, name="dissipation",
         )
         self._gradchi = jit_bound(
             lambda chi, tab1: amr_ops.grad_blocks(
                 geom, tab1.assemble_scalar(chi, g.bs), tab1.width
             ),
-            self._tab1,
+            self._tab1, name="gradchi",
         )
         self._omega_mag = jit_bound(
             lambda vel, tab1: jnp.sqrt(
@@ -569,7 +603,7 @@ class AMRSimulation:
                     axis=-1,
                 )
             ),
-            self._tab1,
+            self._tab1, name="omega_mag",
         )
 
         self._scores = jit_bound(
@@ -577,7 +611,7 @@ class AMRSimulation:
                 amr_ops.vorticity_score(geom, vel, tab1),
                 amr_ops.gradchi_mask(geom, chi, tab1),
             ),
-            self._tab1,
+            self._tab1, name="scores",
         )
 
         if cfg.pipelined:
@@ -592,7 +626,7 @@ class AMRSimulation:
                     for i, c in enumerate(chis)
                 ]
             ),
-            self._xc, self._vol,
+            self._xc, self._vol, name="moments",
         )
 
         self._maxu = _maxu_j
@@ -627,7 +661,8 @@ class AMRSimulation:
             # retraces per regrid as the bucketing equivalence baseline
             from cup3d_tpu.parallel.forest import bind_step_executable
 
-            self._fix_flux = bind_step_executable(fix_flux)
+            self._fix_flux = bind_step_executable(
+                fix_flux, name="fix_flux", store_sig=aot_sig)
 
         if self.mesh is not None:
             self._forest_memo.put(sig, {
@@ -1178,7 +1213,9 @@ class AMRSimulation:
                     bind_order_executables,
                 )
 
-                jits = bind_order_executables(fn, tabs, donate=donate)
+                jits = bind_order_executables(
+                    fn, tabs, donate=donate,
+                    store_sig=self._aot_content_sig(self.grid.signature))
                 return lambda *a: jits[
                     self.step_idx >= self.cfg.step_2nd_start
                 ](*a)

@@ -862,6 +862,81 @@ def test_jx018_package_is_clean():
     assert out.returncode == 0, out.stdout + out.stderr
 
 
+def test_jx019_aot_seam_fires_suppresses_and_scopes():
+    """Direct AOT compile / jit-warmup outside the store seam (round
+    21): a chained ``.lower().compile()`` or an immediately-invoked
+    ``jit(f)(...)`` produces an executable the persistent store never
+    sees — recompiled every boot, invisible to aot.* telemetry."""
+    chain = (
+        "def warm(fn, x):\n"
+        "    return fn.lower(x).compile()\n"
+    )
+    vs = _failing(chain)
+    assert _rules(vs) == {"JX019"} and len(vs) == 1
+    assert "store seam" in vs[0].message
+    # immediately-invoked jit warmups fire, dotted and bare
+    warmup = (
+        "import jax\n"
+        "def warm(f, x):\n"
+        "    return jax.jit(f)(x)\n"
+    )
+    assert _rules(_failing(warmup)) == {"JX019"}
+    bare = (
+        "from jax import jit\n"
+        "def warm(f, x):\n"
+        "    return jit(f)(x)\n"
+    )
+    assert _rules(_failing(bare)) == {"JX019"}
+    # the seam itself and the cost-harvest module are path-exempt
+    assert not _failing(chain, "cup3d_tpu/aot/store.py")
+    assert not _failing(chain, "cup3d_tpu/obs/costs.py")
+    # split lowering (audit.py IR introspection) never fires
+    split = (
+        "def audit(fn, x):\n"
+        "    lowered = fn.lower(x)\n"
+        "    return lowered.as_text()\n"
+    )
+    assert not _failing(split)
+    # a bound jit called later is the normal (legal) pattern
+    bound = (
+        "import jax\n"
+        "def bind(f, x):\n"
+        "    g = jax.jit(f)\n"
+        "    return g(x)\n"
+    )
+    assert not _failing(bound)
+    # str.lower() chains never fire (no .compile() on the result call)
+    strings = (
+        "def norm(s):\n"
+        "    return s.strip().lower()\n"
+    )
+    assert not _failing(strings)
+    # annotation suppresses with the reason recorded
+    ok = chain.replace(
+        "    return fn.lower",
+        "    # jax-lint: allow(JX019, one-shot debug harness)\n"
+        "    return fn.lower",
+    )
+    all_vs = L.lint_source(ok, HOT)
+    assert not [v for v in L.failing(all_vs) if v.rule == "JX019"]
+    assert any(
+        v.rule == "JX019" and v.suppressed and
+        v.suppression_reason == "one-shot debug harness"
+        for v in all_vs)
+
+
+def test_jx019_package_is_clean():
+    """The burn-down stays burned down: every compile-producing call
+    site routes through cup3d_tpu/aot/ (or the exempt obs/costs.py
+    harvest) — baseline EMPTY for this rule."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cup3d_tpu.analysis", "--rules", "JX019",
+         "--no-baseline", "cup3d_tpu/", "-q"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
 def test_jx014_wallclock_duration_fires_and_suppresses():
     """Wall-clock subtraction used as a duration (round 16): NTP slews
     and steps time.time(), so a latency computed from it can go

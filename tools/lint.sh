@@ -1,11 +1,11 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX018
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX019
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
 # swallowed-exception, JX011 bf16-reduction-accumulator, JX012
 # profiler-outside-obs, JX013 per-lane-loop, JX014
 # wall-clock-duration, JX015 per-tick-batch-reassembly, JX016
-# sharded-materialization, JX017 hand-typed-hardware-peak and JX018
-# raw-collective-outside-parallel/ rules)
+# sharded-materialization, JX017 hand-typed-hardware-peak, JX018
+# raw-collective-outside-parallel/ and JX019 aot-seam rules)
 # + the IR audit (rules JP001-JP005: traced jaxprs + AOT alias maps of
 #   the canonical entry points, `python -m cup3d_tpu.analysis audit`)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
@@ -95,6 +95,14 @@ python -m cup3d_tpu.analysis --rules JX017 $PATHS tools/ -q
 # has one place to prove axis/permutation invariants
 echo "== python -m cup3d_tpu.analysis --rules JX018 cup3d_tpu/"
 python -m cup3d_tpu.analysis --rules JX018 cup3d_tpu/ -q
+
+# the AOT store-seam rule on its own line (round 21): a chained
+# .lower().compile() or an immediately-invoked jit(f)(...) warmup
+# outside cup3d_tpu/aot/ fails CI identifiably — compiles route through
+# the persistent executable store (aot.store_backed) so previously-seen
+# signatures deserialize at boot instead of recompiling
+echo "== python -m cup3d_tpu.analysis --rules JX019 cup3d_tpu/"
+python -m cup3d_tpu.analysis --rules JX019 cup3d_tpu/ -q
 
 # the IR audit (round 20): trace + AOT-lower the canonical entry points
 # (uniform/fish/AMR megaloops, fleet advance+reseed, mesh-sharded

@@ -96,7 +96,10 @@ def selftest() -> None:
                 "fleet_skew": {"fleet_occupancy": 0.8 * amr_scale},
                 # round 18: sharded megaloop throughput of the mesh2d
                 # scale-out config — DROPS when the slab path regresses
-                "mesh2d": {"mesh_cells_per_s": 4.0e6 * amr_scale}}
+                "mesh2d": {"mesh_cells_per_s": 4.0e6 * amr_scale},
+                # round 21: warm-store boot-to-first-dispatch of the
+                # cold_start config — RISES when boot starts recompiling
+                "cold_start": {"warm_start_s": 1.5 / amr_scale}}
 
     with tempfile.TemporaryDirectory() as td:
         store = obs_history.HistoryStore(os.path.join(td, "hist.jsonl"))
@@ -120,7 +123,8 @@ def selftest() -> None:
                      "wall_per_step_p95_s", "fleet_cells_per_s",
                      "amr_cells_per_s", "amr_bicgstab_iter_device_ms",
                      "fleet_job_p99_s", "fleet_occupancy",
-                     "mesh_cells_per_s", "fish_bicgstab_bytes_compiler"):
+                     "mesh_cells_per_s", "fish_bicgstab_bytes_compiler",
+                     "warm_start_s"):
             assert by[name]["regressed"], (name, by[name])
         # a malformed line is skipped, not fatal
         with open(store.path, "a") as f:
