@@ -35,13 +35,13 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from cup3d_tpu.analysis import ir as IR
 from cup3d_tpu.analysis import lint as lint_mod
 from cup3d_tpu.analysis.rules import Violation
+from cup3d_tpu.obs import trace as OT
 
 #: devices the sharded entry needs (a 1x4 (lanes, x) mesh)
 MESH_DEVICES = 4
@@ -359,14 +359,14 @@ def audit_entry(ep: EntryPoint) -> Tuple[List[Violation], Dict[str, Any]]:
 
     # jax-lint: allow(JX008, audit wall budget, not a perf measurement:
     # the 60 s lint.sh stage budget is enforced on trace+lower time)
-    t0 = time.perf_counter()
+    t0 = OT.now()
     built = ep.build()
     if built is None:
         return [], {"entry": ep.name, "skipped": True,
                     # jax-lint: allow(JX006, times host-side trace and
                     # lower work only; the audit dispatches no device
                     # execution by design)
-                    "wall_s": round(time.perf_counter() - t0, 3)}
+                    "wall_s": round(OT.now() - t0, 3)}
 
     if built.jaxpr is not None:
         closed = built.jaxpr
@@ -396,7 +396,7 @@ def audit_entry(ep: EntryPoint) -> Tuple[List[Violation], Dict[str, Any]]:
         "entry": ep.name, "skipped": False,
         "compiled": bool(compiled_text is not None),
         "donated_params": donated,
-        "wall_s": round(time.perf_counter() - t0, 3),
+        "wall_s": round(OT.now() - t0, 3),
     }
     return violations, meta
 

@@ -120,6 +120,16 @@ Rule summary (full rationale in ``analysis/rules.py``):
          deserialize instead.  ``cup3d_tpu/aot/`` is the seam itself
          and ``obs/costs.py`` harvests from compiled objects — both
          path-exempt.
+- JX020  raw clock read inside ``cup3d_tpu/`` outside the trace
+         layer: ``time.monotonic()``/``time.time()``/
+         ``time.perf_counter()`` (and ``*_ns`` variants) called
+         anywhere but ``obs/trace.py`` splits the package across
+         clock domains — the round-22 phase decomposition only
+         partitions end-to-end latency because every lifecycle
+         timestamp comes off ONE monotonic clock.  Route monotonic
+         reads through ``obs.trace.now()`` and wall-time stamps
+         through ``obs.trace.wall()``; ``obs/trace.py`` itself is the
+         sanctioned seam and is path-exempt.
 """
 
 from __future__ import annotations
@@ -282,6 +292,14 @@ JX017_MIN_MAGNITUDE = 1e9
 #: the one sanctioned lower().compile()), and obs/costs.py harvests
 #: cost analytics from an already-compiled object
 JX019_EXEMPT_RE = re.compile(r"cup3d_tpu/(aot/|obs/costs\.py$)")
+
+#: JX020 exemption: obs/trace.py IS the clock seam — its ``now()`` /
+#: ``wall()`` own the package's two sanctioned clock reads
+JX020_EXEMPT_RE = re.compile(r"cup3d_tpu/obs/trace\.py$")
+
+#: the ``time``-module attributes JX020 treats as raw clock reads
+JX020_CLOCK_ATTRS = ("time", "monotonic", "perf_counter",
+                     "time_ns", "monotonic_ns", "perf_counter_ns")
 
 
 def _is_power_of_ten(v: float) -> bool:
@@ -570,12 +588,18 @@ class FileLint:
             if (self.path.startswith("cup3d_tpu/")
                     and not JX019_EXEMPT_RE.search(self.path)):
                 self._check_aot_seam(func, qualname)        # JX019
+            if (self.path.startswith("cup3d_tpu/")
+                    and not JX020_EXEMPT_RE.search(self.path)):
+                self._check_raw_clock(func, qualname)       # JX020
         if (self.path.startswith("cup3d_tpu/")
                 and not JX018_EXEMPT_RE.search(self.path)):
             self._check_raw_collectives(self.tree, "<module>")  # JX018
         if (self.path.startswith("cup3d_tpu/")
                 and not JX019_EXEMPT_RE.search(self.path)):
             self._check_aot_seam(self.tree, "<module>")     # JX019
+        if (self.path.startswith("cup3d_tpu/")
+                and not JX020_EXEMPT_RE.search(self.path)):
+            self._check_raw_clock(self.tree, "<module>")    # JX020
         self._check_dtype_literals()                        # JX005
         self._check_swallowed_exceptions(self.tree, "<module>")  # JX009
         self._check_wallclock_duration(self.tree, "<module>")  # JX014
@@ -1524,6 +1548,58 @@ class FileLint:
                         "aot.store_backed(), and warm through the "
                         "wrapper",
                     )
+
+    # -- JX020 -------------------------------------------------------------
+
+    def _raw_clock_names(self) -> Set[str]:
+        """Call names that read a raw ``time``-module clock in this
+        file, resolved from its imports: ``time.monotonic`` (etc.)
+        under whatever alias the module was imported as, plus the bare
+        names ``from time import monotonic [as X]`` leaves behind."""
+        cached = getattr(self, "_jx020_names", None)
+        if cached is not None:
+            return cached
+        names: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        alias = a.asname or a.name
+                        for attr in JX020_CLOCK_ATTRS:
+                            names.add(f"{alias}.{attr}")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time":
+                    for a in node.names:
+                        if a.name in JX020_CLOCK_ATTRS:
+                            names.add(a.asname or a.name)
+        self._jx020_names = names
+        return names
+
+    def _check_raw_clock(self, func: ast.AST, qualname: str) -> None:
+        """Raw ``time.monotonic()``/``time.time()``/``perf_counter()``
+        (and ``*_ns`` variants) inside the package outside
+        ``obs/trace.py``: a second clock domain.  The round-22 phase
+        decomposition partitions end-to-end latency only because every
+        lifecycle timestamp comes off the ONE monotonic clock behind
+        ``obs.trace.now()``; wall stamps go through
+        ``obs.trace.wall()``.  One finding per function (first read in
+        source order) — one fix usually rewires the whole function."""
+        clocks = self._raw_clock_names()
+        if not clocks:
+            return
+        first = None
+        for node in _walk_shallow(func):
+            if isinstance(node, ast.Call) and _call_name(node) in clocks:
+                if first is None or node.lineno < first.lineno:
+                    first = node
+        if first is not None:
+            self._emit(
+                "JX020", first, qualname,
+                f"raw clock read `{_call_name(first)}()` outside "
+                "cup3d_tpu/obs/trace.py splits the package across "
+                "clock domains — use obs.trace.now() for monotonic "
+                "reads or obs.trace.wall() for wall-time stamps",
+            )
 
     # -- JX009 -------------------------------------------------------------
 

@@ -270,6 +270,25 @@ RULES: Dict[str, Rule] = {
             "an already-compiled object — both are path-exempt.",
         ),
         Rule(
+            "JX020",
+            "raw clock read inside cup3d_tpu/ outside obs/trace.py",
+            "time.monotonic()/time.time()/time.perf_counter() (and the "
+            "*_ns variants) called anywhere but obs/trace.py splits the "
+            "package across clock domains: the round-22 latency "
+            "provenance decomposes a job's end-to-end time into "
+            "exclusive phases that sum back exactly, and that partition "
+            "invariant only holds because every lifecycle timestamp — "
+            "fleet marks, compile-service spans, flight-recorder stamps "
+            "— comes off the ONE monotonic clock behind "
+            "obs.trace.now().  A stray time.monotonic() in a subsystem "
+            "is a second epoch: its intervals cannot be subtracted "
+            "against trace timestamps without silent skew.  Monotonic "
+            "reads route through obs.trace.now(); wall-time stamps "
+            "(log/postmortem metadata, never durations — JX014) route "
+            "through obs.trace.wall().  obs/trace.py IS the clock seam "
+            "and is path-exempt.",
+        ),
+        Rule(
             "JP001",
             "donated buffer not aliased in the compiled executable",
             "jit(donate_argnums=...) is a PROMISE, not a guarantee: when "

@@ -230,6 +230,15 @@ def cmd_probe(args, t0: float) -> int:
         v for k, v in snap.items()
         if k.startswith("aot.compile_s{")
         and "advance" in k and k.endswith(".count")))
+    # round-22 provenance ride-along: the drain's aggregate per-phase
+    # seconds (each job's decomposition sums to its e2e, so the totals
+    # attribute the whole drain) and the compile_wait fraction —
+    # bench_cold_start surfaces these as the cold/warm breakdown
+    phase_totals: dict = {}
+    for j in server._jobs.values():
+        for ph, v in j.phases().items():
+            phase_totals[ph] = phase_totals.get(ph, 0.0) + v
+    phase_sum = sum(phase_totals.values())
     report = {
         "first_dispatch_s": (min(dispatched) - t0 if dispatched
                              else None),
@@ -240,6 +249,11 @@ def cmd_probe(args, t0: float) -> int:
         "rows_blake2s": digest.hexdigest(),
         "jobs": {jid: server._jobs[jid].status
                  for jid in sorted(server._jobs)},
+        "phase_totals_s": {ph: round(v, 6)
+                           for ph, v in sorted(phase_totals.items())},
+        "compile_wait_frac": round(
+            phase_totals.get("compile_wait", 0.0) / phase_sum, 6)
+            if phase_sum > 0 else 0.0,
     }
     print(json.dumps(report, indent=2, sort_keys=True))
     bad = sum(st.get("failed", 0) for st in

@@ -60,17 +60,22 @@ class CompileService:
 
     def submit(self, key, build: Callable[[], object],
                name: str = "exec",
-               priority: int = PRIORITY_DEMAND) -> bool:
+               priority: int = PRIORITY_DEMAND,
+               jobs=None) -> bool:
         """Enqueue ``build`` under ``key`` (dedup: a key already
         pending/running/done is left alone; a failed key may be
-        resubmitted).  Returns True when actually enqueued."""
+        resubmitted).  ``jobs`` — the FleetJob ids parked on this build
+        (round-22 causal link): they ride the task into the pid-5
+        Perfetto compile span and its flow arrows.  Returns True when
+        actually enqueued."""
         with self._cv:
             task = self._tasks.get(key)
             if task is not None and task["status"] != FAILED:
                 return False
             self._tasks[key] = {"status": PENDING, "build": build,
                                 "name": str(name), "result": None,
-                                "priority": int(priority)}
+                                "priority": int(priority),
+                                "jobs": list(jobs or [])}
             heapq.heappush(self._heap, (int(priority), self._seq, key))
             self._seq += 1
             self._ensure_worker()
@@ -81,6 +86,19 @@ class CompileService:
             else "demand").inc()
         self._update_depth()
         return True
+
+    def attach(self, key, jobs) -> None:
+        """Merge more waiting-job ids onto an in-flight build: jobs
+        that hit the same cold signature on a LATER scheduling pass
+        still want their flow arrow from the one shared compile span."""
+        with self._cv:
+            task = self._tasks.get(key)
+            if task is None or task["status"] in (DONE, FAILED):
+                return
+            have = task.setdefault("jobs", [])
+            for j in jobs:
+                if j not in have:
+                    have.append(j)
 
     def _ensure_worker(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -179,13 +197,33 @@ class CompileService:
             except Exception:
                 result, status = None, FAILED
                 M.counter("aot.compile_failures", executable=name).inc()
+            t1 = OT.now()
             M.histogram("aot.background_compile_s",
-                        executable=name).observe(OT.now() - t0)
+                        executable=name).observe(t1 - t0)
             with self._cv:
                 task = self._tasks.get(key)
+                jobs = list(task.get("jobs") or ()) if task else []
                 if task is not None:
                     task["status"] = status
                     task["result"] = result
                     task["build"] = None
                 self._cv.notify_all()
             self._update_depth()
+            self._trace_build(name, status, jobs, t0, t1)
+
+    @staticmethod
+    def _trace_build(name: str, status: str, jobs, t0: float,
+                     t1: float) -> None:
+        """Round-22 provenance: one pid-5 compile span per build, plus
+        a flow arrow opened per waiting job (terminated by that job's
+        lane span in fleet/server.py _job_terminal) — a cold-start job
+        reads as one causal chain in the Perfetto UI."""
+        sink = OT.TRACE
+        if not sink.enabled:
+            return
+        sink.compile_span(
+            1, name, t0, t1 - t0,
+            args={"outcome": status, "jobs": list(jobs)})
+        for job_id in jobs:
+            sink.flow_start(job_id, "compile->lane", t1,
+                            OT.COMPILE_PID, 1)

@@ -201,7 +201,17 @@ def reseed_lane_carry(carry, lane, solo, nsteps, mesh=None):
     carry — the input is not donated, so in-flight consumers of the old
     buffers stay valid.  With a ``mesh`` the update runs shard-local
     (:func:`_sharded_lane_upload`) so reseeding a mesh-resident carry
-    never gathers it to one device."""
+    never gathers it to one device.
+
+    Provenance (round 22): this upload is the K-boundary reseed splice
+    — on the waiting job's timeline it sits inside the
+    ``reseed_wait -> reseeded`` interval (``obs.trace.now()`` clock),
+    which the phase decomposition attributes to ``reseed_wait``.  The
+    ``fleet.reseed_uploads`` counter gives the per-scrape rate without
+    waiting for job terminals."""
+    from cup3d_tpu.obs import metrics as M
+
+    M.counter("fleet.reseed_uploads").inc()
     solo = {k: jnp.asarray(solo[k]) for k in carry if k != LEFT}
     up = (_sharded_lane_upload(mesh) if mesh is not None
           else _upload_lane_carry)

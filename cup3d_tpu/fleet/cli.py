@@ -22,6 +22,16 @@ Round 18: ``--mesh`` prints the resolved 2-D (lanes, x) device-mesh
 state JSON (parallel/topology.py mesh_state: axes, shape, per-device
 placement, fallback count) before the drain — the operator's one-look
 answer to "did the fleet actually shard, and across what".
+
+Round 22: ``python -m cup3d_tpu fleet why [job-id] --scenarios ...``
+drains the same way but prints the latency-provenance report: the
+per-phase p50/p99 breakdown (admission / capacity_wait / compile_wait /
+assembly / reseed_wait / dispatch / rollback_retry / retire) and, per
+tenant, the burn attribution — the dominant phase of the current
+window and which phase's share of end-to-end grew against the rolling
+baseline.  With a job id it prints that one job's exact phase
+decomposition (sums to its e2e by construction) instead — the
+operator's answer to "WHY was this job slow".
 """
 
 from __future__ import annotations
@@ -33,10 +43,16 @@ from typing import List, Optional
 from cup3d_tpu.fleet.server import FleetServer, summary_json
 
 
-def _build_parser(slo: bool) -> argparse.ArgumentParser:
-    prog = "python -m cup3d_tpu fleet" + (" slo" if slo else "")
-    desc = ("drain a fleet scenario spec and print the per-tenant "
-            + ("SLO report JSON" if slo else "summary JSON"))
+def _build_parser(mode: Optional[str]) -> argparse.ArgumentParser:
+    slo = mode == "slo"
+    why = mode == "why"
+    prog = "python -m cup3d_tpu fleet" + (f" {mode}" if mode else "")
+    desc = ("drain a fleet scenario spec and print the "
+            + ("latency-provenance report JSON (per-phase p50/p99, "
+               "burn attribution; with a job id, that job's exact "
+               "phase decomposition)" if why else
+               "per-tenant SLO report JSON" if slo else
+               "per-tenant summary JSON"))
     ap = argparse.ArgumentParser(prog=prog, description=desc)
     ap.add_argument("--scenarios", required=True,
                     help="JSON spec: a list of scenarios or "
@@ -65,24 +81,57 @@ def _build_parser(slo: bool) -> argparse.ArgumentParser:
                     help="print the resolved 2-D device-mesh state "
                          "JSON on stderr before draining "
                          "(CUP3D_FLEET_MESH)")
-    if slo:
+    if slo or why:
         ap.add_argument("--slo-p99", type=float, default=None,
                         help="target p99 end-to-end seconds "
                              "(CUP3D_FLEET_SLO_P99)")
         ap.add_argument("--slo-window", type=int, default=None,
                         help="rolling breach window in jobs "
                              "(CUP3D_FLEET_SLO_WINDOW)")
+    if why:
+        ap.add_argument("job_id", nargs="?", default=None,
+                        help="report one job's exact phase "
+                             "decomposition instead of the fleet view")
     return ap
+
+
+def _why_report(server: FleetServer, job_id: Optional[str]) -> dict:
+    """The ``fleet why`` payload: fleet-wide (or one job's) latency
+    provenance.  Per tenant: the per-phase p50/p99 breakdown and the
+    burn attribution (dominant phase of the current window + which
+    phase's e2e share grew vs the rolling baseline)."""
+    if job_id is not None:
+        job = server._jobs[job_id]
+        return {
+            "job_id": job.job_id,
+            "tenant": job.tenant,
+            "status": job.status,
+            "phases": {k: round(v, 6)
+                       for k, v in job.phases().items()},
+            "durations": {k: round(v, 6)
+                          for k, v in job.durations().items()},
+            "events": [[n, round(t, 6)] for n, t in job.events],
+        }
+    tenants = {}
+    for tenant in sorted(server._phase_share_history):
+        tenants[tenant] = server.phase_attribution(tenant)
+    return {
+        "phase_quantiles": server.phase_quantiles(),
+        "tenants": tenants,
+        "jobs": server.jobs_by_status(),
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    slo = bool(argv) and argv[0] == "slo"
-    if slo:
+    mode = argv[0] if argv and argv[0] in ("slo", "why") else None
+    if mode is not None:
         argv = argv[1:]
-    args = _build_parser(slo).parse_args(argv)
+    slo = mode == "slo"
+    why = mode == "why"
+    args = _build_parser(mode).parse_args(argv)
 
     with open(args.scenarios) as f:
         spec = json.load(f)
@@ -122,6 +171,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "quantiles": server.latency_quantiles(),
                   "jobs": server.jobs_by_status()}
         print(json.dumps(report, indent=2, sort_keys=True))
+    elif why:
+        print(json.dumps(_why_report(server, args.job_id),
+                         indent=2, sort_keys=True))
     else:
         print(summary_json(summary))
     bad = sum(

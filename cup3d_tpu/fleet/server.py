@@ -171,13 +171,20 @@ class FleetJob:
     events: List[Tuple[str, float]] = field(default_factory=list)
     _seen: Set[str] = field(default_factory=set, repr=False)
 
-    def mark(self, event: str, once: bool = False) -> None:
+    def mark(self, event: str, once: bool = False,
+             collapse: bool = False) -> None:
         """Append one lifecycle event at the current monotonic time.
         ``once`` drops repeats (dispatched/fanout fire per dispatch
-        otherwise).  The timestamp is clamped non-decreasing: marks may
-        arrive from the dispatch thread and the QoI consumer thread, and
-        the timeline is validated monotone (obs/trace.py)."""
+        otherwise); ``collapse`` drops a repeat only when it would
+        IMMEDIATELY follow itself (compile_wait/reseed_wait re-fire
+        every scheduling pass while the job stays parked — one event
+        per parked stretch is the provenance-correct timeline).  The
+        timestamp is clamped non-decreasing: marks may arrive from the
+        dispatch thread and the QoI consumer thread, and the timeline
+        is validated monotone (obs/trace.py)."""
         if once and event in self._seen:
+            return
+        if collapse and self.events and self.events[-1][0] == event:
             return
         self._seen.add(event)
         t = OT.now()
@@ -196,7 +203,15 @@ class FleetJob:
         """The SLO-relevant durations derivable from the timeline:
         queue-wait (queued -> running), execution (running -> terminal)
         and end-to-end (submitted -> terminal) — all on the monotonic
-        clock, present only when both endpoints were marked."""
+        clock, present only when both endpoints were marked.
+
+        CAVEAT (round 22): ``queue_wait_s`` is kept for schema
+        compatibility but since the round-21 AOT path it CONFLATES two
+        remediable-by-different-means waits — capacity wait (fix:
+        scale out) and background compile wait (fix: warm the store).
+        The split rides alongside as ``capacity_wait_s`` +
+        ``compile_wait_s`` (from :meth:`phases`); prefer those and the
+        full :meth:`phases` decomposition for attribution."""
         out: Dict[str, float] = {}
         if not self.events:
             return out
@@ -206,11 +221,20 @@ class FleetJob:
         t_sub = self.event_time("submitted")
         if t_q is not None and t_run is not None:
             out["queue_wait_s"] = t_run - t_q
+            ph = self.phases()
+            out["capacity_wait_s"] = ph.get("capacity_wait", 0.0)
+            out["compile_wait_s"] = ph.get("compile_wait", 0.0)
         if t_run is not None:
             out["exec_s"] = t_end - t_run
         if t_sub is not None:
             out["e2e_s"] = t_end - t_sub
         return out
+
+    def phases(self) -> Dict[str, float]:
+        """Exact latency-provenance decomposition of the timeline
+        (:func:`cup3d_tpu.obs.trace.phase_decomposition`): exclusive
+        per-phase seconds that sum to end-to-end by construction."""
+        return OT.phase_decomposition(self.events)
 
     def record(self, step: int, row: np.ndarray, t: float) -> None:
         """Append (or re-apply, after a lane rollback replay) the QoI
@@ -761,6 +785,7 @@ class FleetServer:
                  policy: Optional[str] = None,
                  max_queue_depth: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
+                 provenance: Optional[bool] = None,
                  mesh=_MESH_DEFAULT):
         self.max_lanes = int(
             max_lanes if max_lanes is not None
@@ -816,6 +841,17 @@ class FleetServer:
         #: created lazily iff the persistent store is active; with
         #: CUP3D_AOT_STORE unset the whole AOT path is inert
         self._aot_service = None
+        # round 22 — latency provenance: per-job phase decomposition,
+        # fleet.latency_phase_s histograms, flow events, and SLO burn
+        # attribution.  CUP3D_FLEET_PROVENANCE=0 reverts _job_terminal
+        # to the round-16 aggregate-only bookkeeping (the bench.py
+        # _provenance_overhead gate measures exactly this delta).
+        self.provenance = bool(
+            provenance if provenance is not None
+            else _env_int("CUP3D_FLEET_PROVENANCE", 1))
+        #: per-tenant rolling history of per-job phase SHARES (phase
+        #: seconds / e2e), newest last — the burn-attribution baseline
+        self._phase_share_history: Dict[str, deque] = {}
         _LIVE.append(weakref.ref(self))
 
     # -- AOT store / background compile (round 21) -------------------------
@@ -893,6 +929,7 @@ class FleetServer:
             cap, K, mesh = self._batch_shape(members)
             ekey = self._background_key(sig, cap, K, mesh)
             if ekey in self._execs:
+                self._mark_compile_ready(members)
                 ready[key] = members
                 continue
             status = svc.status(ekey)
@@ -901,10 +938,13 @@ class FleetServer:
                 if fn is not None:
                     self._execs[ekey] = fn
                     M.counter("aot.background_installs").inc()
+                self._mark_compile_ready(members)
                 ready[key] = members
                 continue
             if status in ("pending", "running"):
+                svc.attach(ekey, [job_m.job_id for _, job_m, _ in members])
                 for kind_m, job_m, drv_m in members:
+                    job_m.mark("compile_wait", collapse=True)
                     self._prepared[job_m.job_id] = (
                         kind_m, drv_m, sig, key)
                 continue
@@ -912,11 +952,22 @@ class FleetServer:
                     self._store_sig(sig, cap, K, self._mesh_key(mesh))):
                 # failed background build -> synchronous fallback;
                 # store present -> assembling now is a disk read
+                self._mark_compile_ready(members)
                 ready[key] = members
                 continue
             self._submit_background(svc, st, sig, cap, K, kind, mesh,
                                     drv, job, members, ekey, key)
         return ready
+
+    @staticmethod
+    def _mark_compile_ready(members) -> None:
+        """Close the compile_wait interval on every member that opened
+        one: the group's executable is now installable, so from here the
+        timeline is back in "assembly" (round-22 provenance).  Members
+        that never waited (warm signature) are untouched."""
+        for _kind, job_m, _drv in members:
+            if job_m.event_time("compile_wait") is not None:
+                job_m.mark("compile_ready", collapse=True)
 
     def _submit_background(self, svc, st, sig, cap, K, kind, mesh,
                            drv, job, members, ekey, bucket_key) -> None:
@@ -939,9 +990,15 @@ class FleetServer:
                 warm(*avals)
             return fn
 
+        # the demand build is causally linked to the jobs that wait on
+        # it (round 22): their ids ride the compile task into the pid-5
+        # Perfetto span + flow events, and each job's timeline opens a
+        # compile_wait interval here
         svc.submit(ekey, demand_build, name=label,
-                   priority=aot_compiler.PRIORITY_DEMAND)
+                   priority=aot_compiler.PRIORITY_DEMAND,
+                   jobs=[job_m.job_id for _, job_m, _ in members])
         for kind_m, job_m, drv_m in members:
+            job_m.mark("compile_wait", collapse=True)
             self._prepared[job_m.job_id] = (kind_m, drv_m, sig,
                                             bucket_key)
         if not aot_compiler.speculate_enabled():
@@ -1261,7 +1318,11 @@ class FleetServer:
                 continue
             if blocked:
                 # a live compatible batch will free a lane at a coming
-                # K-boundary; waiting beats padding out a fresh batch
+                # K-boundary; waiting beats padding out a fresh batch.
+                # The wait is a distinct provenance phase (reseed_wait):
+                # neither capacity (lanes exist) nor compile (executable
+                # is warm) — collapse keeps one event per parked stretch
+                job.mark("reseed_wait", collapse=True)
                 self._prepared[job.job_id] = prep
                 waiting.setdefault(key, []).append((kind, job, drv))
                 continue
@@ -1351,6 +1412,20 @@ class FleetServer:
         if "exec_s" in durs:
             M.histogram("fleet.job_exec_s", tenant=job.tenant,
                         bucket=bucket).observe(durs["exec_s"])
+        # round 22 — latency provenance: the exact phase decomposition
+        # (sums to e2e by construction) feeds the federation-mergeable
+        # per-phase histograms and the burn-attribution share history.
+        # CUP3D_FLEET_PROVENANCE=0 skips all of it (overhead gate).
+        phases = job.phases() if self.provenance else None
+        if phases:
+            for ph, v in phases.items():
+                M.histogram("fleet.latency_phase_s", phase=ph,
+                            tenant=job.tenant).observe(v)
+            total = sum(phases.values())
+            if total > 0:
+                self._phase_share_history.setdefault(
+                    job.tenant, deque(maxlen=64)).append(
+                        {ph: v / total for ph, v in phases.items()})
         e2e = durs.get("e2e_s")
         if e2e is not None:
             M.histogram("fleet.job_e2e_s", tenant=job.tenant,
@@ -1382,6 +1457,10 @@ class FleetServer:
             job.job_id, job.tenant, job.status, job.steps_done,
             job.events, bucket=bucket,
             durations={k: round(v, 6) for k, v in durs.items()})
+        if phases:
+            # unrounded: trace_check asserts the partition invariant to
+            # float eps against the event-timeline span
+            rec["phases"] = phases
         if batch is not None and lane is not None:
             rec["batch"] = int(batch.batch_id)
             rec["lane"] = int(lane)
@@ -1399,6 +1478,13 @@ class FleetServer:
                 if name == "rollback":
                     sink.lane_instant(tid, "rollback", t,
                                       args={"job_id": job.job_id})
+            if (self.provenance
+                    and job.event_time("compile_wait") is not None):
+                # terminate the flow arrow the compile service opened:
+                # the arrow lands inside this job's lane-occupancy span,
+                # tying cold-start wait to its build in the trace UI
+                sink.flow_finish(job.job_id, "compile->lane", t_run,
+                                 OT.LANE_PID, tid)
 
     def latency_quantiles(self, name: str = "fleet.job_e2e_s",
                           tenant: Optional[str] = None,
@@ -1414,6 +1500,65 @@ class FleetServer:
                  if tenant is None or h.labels.get("tenant") == tenant]
         return {f"p{int(round(q * 100))}": M.merged_quantile(hists, q)
                 for q in qs}
+
+    def phase_quantiles(self, tenant: Optional[str] = None,
+                        qs: Tuple[float, ...] = (0.5, 0.99)
+                        ) -> Dict[str, Dict[str, Optional[float]]]:
+        """Per-phase latency quantiles over the round-22
+        ``fleet.latency_phase_s`` family (optionally one tenant's
+        slice), bucket counts merged across label sets exactly like
+        :meth:`latency_quantiles`.  Only phases that observed at least
+        one job appear."""
+        out: Dict[str, Dict[str, Optional[float]]] = {}
+        fam = M.histograms("fleet.latency_phase_s")
+        for ph in OT.JOB_PHASES:
+            hists = [h for h in fam
+                     if h.labels.get("phase") == ph
+                     and (tenant is None
+                          or h.labels.get("tenant") == tenant)]
+            if hists:
+                out[ph] = {
+                    f"p{int(round(q * 100))}": M.merged_quantile(
+                        hists, q)
+                    for q in qs}
+        return out
+
+    def phase_attribution(self, tenant: str) -> Optional[dict]:
+        """SLO burn attribution for one tenant: which phase dominates
+        the current latency window, and which phase's SHARE of
+        end-to-end grew against the rolling baseline (the
+        obs/history.py median machinery).  Shares are per-job
+        phase-seconds / e2e, so they are scale-free: a fleet that got
+        uniformly slower shows zero deltas, while a compile storm shows
+        compile_wait's share growing.  None until a first job retires
+        (or with provenance off)."""
+        from cup3d_tpu.obs import history as obs_history
+
+        shares = self._phase_share_history.get(tenant)
+        if not shares:
+            return None
+        recent = list(shares)[-8:]
+        quantiles = self.phase_quantiles(tenant=tenant, qs=(0.99,))
+        phases: Dict[str, dict] = {}
+        dominant = grew = None
+        dom_share = grew_delta = 0.0
+        for ph in OT.JOB_PHASES:
+            series = [s.get(ph, 0.0) for s in shares]
+            share = sum(s.get(ph, 0.0) for s in recent) / len(recent)
+            base = obs_history.rolling_baseline(series, window=32)
+            delta = share - base
+            phases[ph] = {
+                "p99_s": quantiles.get(ph, {}).get("p99"),
+                "share": round(share, 4),
+                "baseline_share": round(base, 4),
+                "delta": round(delta, 4),
+            }
+            if dominant is None or share > dom_share:
+                dominant, dom_share = ph, share
+            if grew is None or delta > grew_delta:
+                grew, grew_delta = ph, delta
+        return {"dominant_phase": dominant, "grew_phase": grew,
+                "phases": phases}
 
     def slo_status(self) -> dict:
         """The per-tenant SLO view (health()["slo"], fleet slo CLI):
@@ -1432,6 +1577,12 @@ class FleetServer:
                 "burn_rate": round(frac / self.SLO_ERROR_BUDGET, 2),
                 "quantiles": self.latency_quantiles(tenant=tenant),
             }
+            if frac > self.SLO_ERROR_BUDGET and self.provenance:
+                # the budget is burning ahead of plan: attach the
+                # round-22 phase attribution so /health names the
+                # phase to remediate (capacity vs compile vs reseed)
+                tenants[tenant]["attribution"] = \
+                    self.phase_attribution(tenant)
         return {
             "target_p99_s": self.slo_p99_s,
             "window": self.slo_window,

@@ -40,7 +40,6 @@ import math
 import os
 import re
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
@@ -257,7 +256,7 @@ def health_payload() -> dict:
 
     return {
         "status": "ok",
-        "time": time.time(),
+        "time": _trace.wall(),
         "flight_recorders": flights,
         "recovery_counters": counters,
         "fleet": fleet,

@@ -251,6 +251,26 @@ class FederatedView:
         per-process group (bucket sums + min-of-mins / max-of-maxes)."""
         return _metrics.merged_quantile(self.merged(name, **labels), q)
 
+    def phase_quantiles(self, tenant: Optional[str] = None,
+                        qs: Sequence[float] = (0.5, 0.99)) -> dict:
+        """Fleet-wide per-phase latency quantiles (round 22): exact
+        merged quantiles of ``fleet.latency_phase_s`` across every
+        process snapshot, keyed by phase — the federated analogue of
+        ``FleetServer.phase_quantiles``.  With ``tenant=None`` the
+        groups pool across tenants; pooling histogram groups keeps the
+        quantiles exact (bucket sums are associative)."""
+        pooled: Dict[str, List[_metrics.Histogram]] = {}
+        for (name, lkey), group in self._groups.items():
+            if name != "fleet.latency_phase_s":
+                continue
+            labels = dict(lkey)
+            if tenant is not None and labels.get("tenant") != tenant:
+                continue
+            pooled.setdefault(labels.get("phase", ""), []).extend(group)
+        return {ph: {f"p{int(round(q * 100))}":
+                     _metrics.merged_quantile(group, q) for q in qs}
+                for ph, group in sorted(pooled.items())}
+
     def skew(self, ratio: Optional[float] = None) -> dict:
         """Fleet-wide straggler assessment over every process's
         per-shard walls (the federated analogue of

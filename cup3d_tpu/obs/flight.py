@@ -35,12 +35,12 @@ from __future__ import annotations
 import json
 import math
 import os
-import time
 import weakref
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from cup3d_tpu.obs import metrics as _metrics
+from cup3d_tpu.obs import trace as _trace
 
 SCHEMA_VERSION = 1
 
@@ -211,7 +211,7 @@ class FlightRecorder:
         payload = {
             "schema": SCHEMA_VERSION,
             "reason": reason,
-            "wall_time": time.time(),
+            "wall_time": _trace.wall(),
             "triggered_at_step": _jsonable(at_step),
             "last_known_good_step": self.last_known_good_step,
             "config": _jsonable(self.run_config),
@@ -224,6 +224,7 @@ class FlightRecorder:
             "metrics": _jsonable(_metrics.snapshot()),
             "mesh": _mesh_block(),
             "shard_walls": _shard_block(),
+            "aot": _aot_block(),
         }
         os.makedirs(self.directory or ".", exist_ok=True)
         tag = at_step if at_step is not None else len(self.steps)
@@ -259,6 +260,40 @@ def _shard_block() -> Dict:
         return _jsonable(_federate.STRAGGLER.health())
     except Exception as e:
         _metrics.counter("flight.mesh_probe_errors").inc()
+        return {"probe_error": repr(e)}
+
+
+def _aot_block() -> Dict:
+    """AOT store + compile-service state at dump time (round 22): store
+    hits/misses/rejects-by-reason plus the background service's queue
+    depth and in-flight builds, so a compile-storm-induced death is
+    visible in the postmortem.  ``active: False`` when the store is
+    inert (CUP3D_AOT_STORE unset); guarded like the mesh probes."""
+    try:
+        from cup3d_tpu.aot import store as _aot_store
+        from cup3d_tpu.fleet import server as _fleet_server
+
+        st = _aot_store.active_store()
+        services = [
+            srv._aot_service.state()
+            for srv in _fleet_server.live_servers()
+            if srv._aot_service is not None
+        ]
+        rejects = {
+            str(c.labels.get("reason", "")): int(c.value)
+            for c in _metrics.counters("aot.store_rejects")
+        }
+        return _jsonable({
+            "active": st is not None,
+            "store": st.state() if st is not None else None,
+            "store_hits": int(_metrics.counter("aot.store_hits").value),
+            "store_misses": int(
+                _metrics.counter("aot.store_misses").value),
+            "store_rejects": rejects,
+            "services": services,
+        })
+    except Exception as e:
+        _metrics.counter("flight.aot_probe_errors").inc()
         return {"probe_error": repr(e)}
 
 

@@ -23,12 +23,12 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from dataclasses import dataclass
 from statistics import median
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from cup3d_tpu.obs import metrics as _metrics
+from cup3d_tpu.obs import trace as _trace
 
 STORE_SCHEMA = 1
 
@@ -133,6 +133,19 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("detail", "warm_start_s")),
         higher_is_better=False,
     ),
+    # round 22 (latency provenance): fraction of the fleet_skew
+    # window's total phase-seconds spent in compile_wait — jobs parked
+    # on background XLA builds.  A rise means the AOT store stopped
+    # absorbing compiles (key drift, speculation miss, store churn);
+    # the remedy is warming the store, NOT scaling out, which is
+    # exactly why it is tracked separately from occupancy/p99;
+    # lower is better
+    MetricSpec(
+        "fleet_compile_wait_frac",
+        (("fleet_skew", "fleet_compile_wait_frac"),
+         ("detail", "fleet_compile_wait_frac")),
+        higher_is_better=False,
+    ),
 )
 
 
@@ -158,6 +171,18 @@ def extract(summary: dict, spec: MetricSpec) -> Optional[float]:
     return None
 
 
+def rolling_baseline(series: Sequence[float], window: int = 5) -> float:
+    """Median of the up-to-``window`` values PRECEDING the newest — the
+    regression-detection baseline, factored out (round 22) so the fleet
+    burn attribution (``fleet/server.py phase_attribution``) judges
+    phase shares against the same median machinery the bench gate uses.
+    With fewer than two points there is no "previous" to take a median
+    of; the newest value (or 0.0 on empty) is its own baseline."""
+    if len(series) < 2:
+        return float(series[-1]) if series else 0.0
+    return float(median(series[-(window + 1):-1]))
+
+
 class HistoryStore:
     """Append-only JSONL store of bench summaries."""
 
@@ -166,7 +191,7 @@ class HistoryStore:
 
     def append(self, summary: dict, ts: Optional[float] = None) -> dict:
         wrapper = {"schema": STORE_SCHEMA,
-                   "ts": time.time() if ts is None else float(ts),
+                   "ts": _trace.wall() if ts is None else float(ts),
                    "summary": summary}
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with open(self.path, "a") as f:
@@ -223,7 +248,7 @@ def detect_regressions(summaries: Sequence[dict],
                             "reason": "insufficient history (<2 points)"})
             continue
         current = series[-1]
-        baseline = median(series[-(window + 1):-1])
+        baseline = rolling_baseline(series, window=window)
         if baseline == 0:
             reports.append({"metric": spec.name, "n": len(series),
                             "regressed": False,

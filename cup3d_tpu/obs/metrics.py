@@ -333,9 +333,21 @@ class MetricsRegistry:
         """Every registered Histogram (optionally filtered by metric
         name across all label sets) — the exporter renders ``_bucket``
         series from these, and the fleet server merges a family's
-        buckets for aggregate p50/p95/p99."""
+        buckets for aggregate p50/p95/p99.  Round 22: the per-phase
+        ``fleet.latency_phase_s{phase,tenant}`` family rides this
+        accessor for phase quantiles + burn attribution
+        (fleet/server.py ``phase_quantiles``)."""
         return [m for m in list(self._metrics.values())
                 if isinstance(m, Histogram)
+                and (name is None or m.name == name)]
+
+    def counters(self, name: Optional[str] = None) -> List[Counter]:
+        """Every registered Counter (optionally one family across all
+        label sets) — the round-22 postmortem ``aot`` block reads the
+        ``aot.store_rejects{reason}`` family this way without knowing
+        the reason label values in advance."""
+        return [m for m in list(self._metrics.values())
+                if isinstance(m, Counter)
                 and (name is None or m.name == name)]
 
     def snapshot(self) -> Dict[str, float]:
@@ -398,6 +410,10 @@ def histogram(name: str, **labels) -> Histogram:
 
 def histograms(name: Optional[str] = None) -> List[Histogram]:
     return REGISTRY.histograms(name)
+
+
+def counters(name: Optional[str] = None) -> List[Counter]:
+    return REGISTRY.counters(name)
 
 
 def snapshot() -> Dict[str, float]:

@@ -50,7 +50,6 @@ import gzip
 import json
 import os
 import re
-import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -488,7 +487,7 @@ def merge_into_sink(sink: obs_trace.TraceSink, attr: DeviceAttribution,
     sink.aux(rec)
     if not attr.events:
         return
-    now_us = (time.perf_counter() - sink.epoch) * 1e6
+    now_us = (obs_trace.now() - sink.epoch) * 1e6
     end_us = max(e["ts"] + e["dur"] for e in attr.events)
     offset = now_us - end_us
     sink.events.append({

@@ -72,7 +72,16 @@ per shard per evaluated K-boundary (``obs/federate.py``):
 
 with matching pid-4 per-shard tracks (:data:`SHARD_PID`) in the
 Perfetto export: one X span per shard per K-boundary, so a straggling
-shard is visible as a longer bar next to the lane/device tracks.  Every lifecycle timestamp is
+shard is visible as a longer bar next to the lane/device tracks.
+
+Round 22 (latency provenance) extends the job record with an optional
+``phases`` block — the exact per-phase decomposition of end-to-end
+latency (:func:`phase_decomposition`, :data:`JOB_PHASES`) whose values
+sum to the event-timeline span by construction — plus pid-5 background
+compile-service spans (:data:`COMPILE_PID`) and Perfetto FLOW events
+(``ph:"s"``/``"f"``, keyed by job id) that tie a compile span to the
+lane spans of the jobs that waited on it, so a cold-start job reads as
+one causal chain in the trace UI.  Every lifecycle timestamp is
 :func:`now` — host ``perf_counter`` on the sink's epoch, taken only at
 lifecycle seams; nothing here reads a device value.
 
@@ -117,9 +126,67 @@ JOB_REQUIRED = {"schema": int, "step": int, "job_id": str, "tenant": str,
 #: "reseeded" marks a job spliced into a freed lane of a live batch at
 #: a K-boundary (continuous batching, round 17) instead of waiting for
 #: a fresh assembly; it follows "bucketed" on that path.
-JOB_EVENTS = ("submitted", "queued", "bucketed", "reseeded", "running",
+#: "compile_wait"/"compile_ready" bracket the interval a job spends
+#: parked on a background CompileService build (round 21 AOT path);
+#: "reseed_wait" marks a job blocked on a live compatible batch with no
+#: free lane (it waits for a K-boundary reseed instead of capacity).
+JOB_EVENTS = ("submitted", "queued", "bucketed", "compile_wait",
+              "compile_ready", "reseed_wait", "reseeded", "running",
               "dispatched", "fanout", "rollback", "retire",
               "done", "failed", "cancelled")
+
+#: the exclusive latency-provenance phases (round 22).  Every interval
+#: between consecutive job events is attributed to exactly one phase —
+#: the phase of the event that STARTS the interval (PHASE_OF_EVENT) —
+#: so the per-phase sums partition end-to-end latency by construction
+#: (the SpanTimer self-time invariant, lifted to whole lifecycles).
+JOB_PHASES = ("admission", "capacity_wait", "compile_wait", "assembly",
+              "reseed_wait", "dispatch", "rollback_retry", "retire")
+
+#: event name -> the phase of the interval it OPENS.  Terminal events
+#: ("done"/"failed"/"cancelled") close the timeline and open nothing;
+#: they are mapped defensively so a malformed mid-timeline terminal
+#: still attributes rather than KeyErrors.
+PHASE_OF_EVENT = {
+    "submitted": "admission",
+    "queued": "capacity_wait",
+    "bucketed": "assembly",
+    "compile_wait": "compile_wait",
+    "compile_ready": "assembly",
+    "reseed_wait": "reseed_wait",
+    "reseeded": "reseed_wait",
+    "running": "dispatch",
+    "dispatched": "dispatch",
+    "fanout": "dispatch",
+    "rollback": "rollback_retry",
+    "shard_lost": "rollback_retry",
+    "retire": "retire",
+    "done": "retire",
+    "failed": "retire",
+    "cancelled": "retire",
+}
+
+
+def phase_decomposition(events) -> Dict[str, float]:
+    """Exact per-phase decomposition of one job timeline.
+
+    ``events`` is the (name, t) pair sequence of a ``kind="job"`` record
+    (append order, t non-decreasing).  Each consecutive interval
+    ``[t_i, t_{i+1})`` is attributed to ``PHASE_OF_EVENT[name_i]``;
+    unknown names degrade to "retire" rather than raising so a future
+    event name cannot break old tooling.  The values sum to
+    ``t_last - t_first`` EXACTLY (same floats, same additions) — the
+    partition invariant tools/trace_check.py and the round-22 tests
+    assert.  Only phases with nonzero mass appear."""
+    out: Dict[str, float] = {}
+    prev_name = None
+    prev_t = None
+    for name, t in events:
+        if prev_name is not None:
+            phase = PHASE_OF_EVENT.get(prev_name, "retire")
+            out[phase] = out.get(phase, 0.0) + (float(t) - prev_t)
+        prev_name, prev_t = name, float(t)
+    return out
 
 #: required keys of a kind="shard" auxiliary record (round 19 — the
 #: mesh straggler watch in obs/federate.py): one per shard per
@@ -136,14 +203,30 @@ LANE_PID = 3
 #: Perfetto pid of the per-shard K-boundary wall tracks (round 19)
 SHARD_PID = 4
 
+#: Perfetto pid of the background compile-service track (round 22):
+#: one X span per CompileService build, flow-linked (ph "s"/"f") to the
+#: pid-3 lane spans of the jobs that waited on it.
+COMPILE_PID = 5
+
 
 def now() -> float:
     """Monotonic lifecycle timestamp: ``perf_counter`` seconds on the
     same clock as the trace epoch.  The sanctioned primitive for
     ``fleet/`` lifecycle seams — JX008 keeps ad-hoc ``perf_counter``
-    out of the package and JX014 bans wall-clock subtraction, so every
-    duration in the job observatory derives from THIS clock."""
+    out of the package, JX014 bans wall-clock subtraction, and JX020
+    (round 22) routes every raw clock read in the package through this
+    module — so every duration in the job observatory derives from THIS
+    clock."""
     return time.perf_counter()
+
+
+def wall() -> float:
+    """Wall-clock TIMESTAMP (unix epoch seconds) — for labeling records
+    with absolute time, never for durations (JX014).  The sanctioned
+    ``time.time`` seam under JX020: call sites outside this module use
+    :func:`wall`/:func:`now` so the package has exactly one clock-domain
+    boundary to audit."""
+    return time.time()
 
 
 def job_record(job_id: str, tenant: str, status: str, steps_done: int,
@@ -189,6 +272,34 @@ def _validate_job_record(rec: dict) -> List[str]:
             )
             break
         prev_t = ev[1]
+    phases = rec.get("phases")
+    if phases is not None and not problems:
+        problems.extend(_validate_phases_block(phases, rec["events"]))
+    return problems
+
+
+def _validate_phases_block(phases, events) -> List[str]:
+    """Round-22 checks for an optional ``phases`` block on a job record:
+    a dict of known phase names to nonnegative numbers whose sum equals
+    the event-timeline span (the partition invariant) to float eps."""
+    problems: List[str] = []
+    if not isinstance(phases, dict):
+        return ["phases must be a dict"]
+    for k, v in phases.items():
+        if not isinstance(k, str) or k not in JOB_PHASES:
+            problems.append(f"phases key {k!r} not in JOB_PHASES")
+        elif (not isinstance(v, (int, float)) or isinstance(v, bool)
+              or v < 0):
+            problems.append(f"phases[{k!r}] must be a number >= 0")
+    if problems or not events:
+        return problems
+    span = float(events[-1][1]) - float(events[0][1])
+    total = sum(float(v) for v in phases.values())
+    if abs(total - span) > 1e-9 * max(1.0, abs(span)) + 1e-12:
+        problems.append(
+            f"phases sum {total!r} != event span {span!r} "
+            "(phase decomposition must partition e2e)"
+        )
     return problems
 
 
@@ -416,6 +527,7 @@ class TraceSink:
         self._writer: Optional[_AsyncLineWriter] = None
         self._lane_meta_emitted = False
         self._shard_meta_emitted = False
+        self._compile_meta_emitted = False
         self._lock = threading.Lock()
         # round-13 satellite: the TraceAnnotation class resolves ONCE at
         # construction/configure time, so the span hot path is a single
@@ -445,6 +557,7 @@ class TraceSink:
         self.steps_dropped = 0
         self._lane_meta_emitted = False
         self._shard_meta_emitted = False
+        self._compile_meta_emitted = False
         self._annotation_cls = self._resolve_annotation()
         return self
 
@@ -565,6 +678,61 @@ class TraceSink:
             "args": a,
         })
         _metrics.counter("trace.shard_spans").inc()
+
+    def _ensure_compile_meta(self) -> None:
+        if not self._compile_meta_emitted:
+            self._compile_meta_emitted = True
+            self.events.append({
+                "name": "process_name", "ph": "M", "pid": COMPILE_PID,
+                "ts": 0, "args": {"name": "compile service"},
+            })
+
+    def compile_span(self, tid: int, name: str, t0: float, dur: float,
+                     args: Optional[dict] = None) -> None:
+        """One closed background-compile span on the pid-5 track
+        (``t0``/``dur`` in :func:`now` seconds).  ``tid`` is the compile
+        worker's stable track id; ``args`` carries the executable label,
+        outcome, and the waiting job ids.  Emits the pid-5
+        ``process_name`` metadata event once per sink."""
+        if not self.enabled:
+            return
+        self._ensure_compile_meta()
+        self.events.append({
+            "name": name, "ph": "X", "pid": COMPILE_PID, "tid": int(tid),
+            "ts": (t0 - self.epoch) * 1e6, "dur": dur * 1e6,
+            "args": dict(args or {}),
+        })
+        _metrics.counter("trace.compile_spans").inc()
+
+    def flow_start(self, flow_id: str, name: str, t: float, pid: int,
+                   tid: int) -> None:
+        """Open one Perfetto flow arrow (``ph:"s"``) at (pid, tid, t).
+        Flows tie causally-related spans on DIFFERENT tracks into one
+        chain the trace UI draws as an arrow — round 22 links a compile
+        span (pid 5) to the lane span of each job that waited on it.
+        ``flow_id`` is any stable string (the job id)."""
+        if not self.enabled:
+            return
+        self.events.append({
+            "name": name, "ph": "s", "cat": "flow", "id": str(flow_id),
+            "pid": int(pid), "tid": int(tid),
+            "ts": (t - self.epoch) * 1e6,
+        })
+        _metrics.counter("trace.flow_events").inc()
+
+    def flow_finish(self, flow_id: str, name: str, t: float, pid: int,
+                    tid: int) -> None:
+        """Terminate a flow arrow (``ph:"f"``, binding point "e" =
+        enclosing slice) at (pid, tid, t) — the receiving end of a
+        :meth:`flow_start` with the same ``flow_id``."""
+        if not self.enabled:
+            return
+        self.events.append({
+            "name": name, "ph": "f", "bp": "e", "cat": "flow",
+            "id": str(flow_id), "pid": int(pid), "tid": int(tid),
+            "ts": (t - self.epoch) * 1e6,
+        })
+        _metrics.counter("trace.flow_events").inc()
 
     def aux(self, record: dict) -> None:
         """One kind-tagged auxiliary JSONL record interleaved with the

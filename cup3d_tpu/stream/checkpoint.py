@@ -31,7 +31,6 @@ end, and anything that must read a checkpoint back calls it first.
 from __future__ import annotations
 
 import pickle
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
@@ -41,6 +40,7 @@ from cup3d_tpu.io.checkpoint import (
     materialize_payload,
     write_payload,
 )
+from cup3d_tpu.obs import trace as _trace
 
 
 class AsyncCheckpointer:
@@ -112,7 +112,7 @@ class AsyncCheckpointer:
         # drivers wrap save() in their Checkpoint profiler span)
         # jax-lint: allow(JX006, the pre-window calls are host-side
         # future bookkeeping (_reap_done), not device dispatches)
-        t0 = time.perf_counter()
+        t0 = _trace.now()
         payload = build_payload(driver)
         # deep-freeze host-mutable obstacle state (device arrays and the
         # sim backref are dropped by Obstacle.__getstate__ / restored on
@@ -153,14 +153,14 @@ class AsyncCheckpointer:
         # jax-lint: allow(JX006, snapshot_s measures the HOST staging
         # cost the step loop pays; the device copy is intentionally not
         # awaited here — overlapping it is the point of the async path)
-        self.stats["snapshot_s"] += time.perf_counter() - t0
+        self.stats["snapshot_s"] += _trace.now() - t0
         return path
 
     def _write(self, payload: dict, path: str) -> str:
         # jax-lint: allow(JX008, write_s runs on the background writer
         # thread — obs spans are main-thread; the counter reaches the
         # registry via the __init__ collector)
-        t0 = time.perf_counter()
+        t0 = _trace.now()
         try:
             out = write_payload(materialize_payload(payload), path)
         except Exception:
@@ -169,7 +169,7 @@ class AsyncCheckpointer:
         # jax-lint: allow(JX006, materialize_payload host-reads every
         # staged field inside the window — a transitive sync the AST
         # cannot see; the wall here is true background-write cost)
-        self.stats["write_s"] += time.perf_counter() - t0
+        self.stats["write_s"] += _trace.now() - t0
         return out
 
     def wait(self) -> None:

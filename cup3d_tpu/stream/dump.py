@@ -31,7 +31,6 @@ microseconds of handoff instead of a blocking field read + serial write.
 from __future__ import annotations
 
 import os
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -43,6 +42,7 @@ from cup3d_tpu.io.dump import (
     _cell_geometry_blocks,
     _cell_geometry_uniform,
 )
+from cup3d_tpu.obs import trace as _trace
 
 
 def _auto_shards() -> int:
@@ -243,7 +243,7 @@ class AsyncDumper:
         # jax-lint: allow(JX008, submit_s is the dumper's native counter,
         # surfaced process-wide through the obs collector in __init__;
         # drivers additionally wrap submit in their Dump profiler span)
-        t0 = time.perf_counter()
+        t0 = _trace.now()
         staged = {}
         for name, arr in fields.items():
             try:
@@ -268,13 +268,13 @@ class AsyncDumper:
         # jax-lint: allow(JX006, submit_s measures the HOST staging cost
         # the step loop pays; the async device copy is intentionally not
         # awaited — the background _write syncs when it lands)
-        self.stats["submit_s"] += time.perf_counter() - t0
+        self.stats["submit_s"] += _trace.now() - t0
 
     def _write(self, prefix, time_, grid, staged, step=None):
         # jax-lint: allow(JX008, write_s runs on the background writer
         # thread — obs spans are main-thread (SpanTimer stack); the
         # counter reaches the registry via the __init__ collector)
-        t0 = time.perf_counter()
+        t0 = _trace.now()
         host = {k: np.asarray(v) for k, v in staged.items()}
         from cup3d_tpu.resilience import faults, writeguard
 
@@ -301,7 +301,7 @@ class AsyncDumper:
             obs_metrics.counter("dump.write_dropped").inc()
             return None
         self.stats["bytes_written"] += out["bytes_written"]
-        self.stats["write_s"] += time.perf_counter() - t0
+        self.stats["write_s"] += _trace.now() - t0
         return out
 
     def wait(self) -> None:

@@ -46,12 +46,13 @@ Round-6 additions (the ``stream/`` subsystem, ISSUE 1):
 
 from __future__ import annotations
 
-import time
 import weakref
 from contextlib import nullcontext
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from cup3d_tpu.obs import trace as _trace
 
 
 class PackPolicy:
@@ -290,10 +291,10 @@ class QoIStream:
         # native counter — it feeds the obs registry via the collector
         # registered in __init__; the StreamWait/StreamRead spans above
         # are exactly the obs attribution the rule asks for)
-        t0 = time.perf_counter()
+        t0 = _trace.now()
         with ctx:
             vals = np.asarray(holder["batch"], np.float64)
-        elapsed = time.perf_counter() - t0
+        elapsed = _trace.now() - t0
         self.stats["stall_s" if not was_ready else "read_s"] += elapsed
         self.stats["groups_read"] += 1
         off = 0

@@ -241,7 +241,9 @@ def test_jx008_manual_timing_fires_suppresses_and_scopes():
         "    return t1 - t0\n"
     )
     # one finding per function, at the FIRST perf_counter read
-    vs = _failing(src, "cup3d_tpu/io/fixture.py")
+    # (JX020 also fires — perf_counter is double-jeopardy by design)
+    vs = [v for v in _failing(src, "cup3d_tpu/io/fixture.py")
+          if v.rule == "JX008"]
     assert [v.rule for v in vs] == ["JX008"] and vs[0].line == 3
     assert "obs spans" in vs[0].message
     # annotation suppresses it
@@ -250,9 +252,11 @@ def test_jx008_manual_timing_fires_suppresses_and_scopes():
         "    # jax-lint: allow(JX008, native counter feeding the obs "
         "registry)\n    t0 = ",
     )
-    assert not _failing(ok, "cup3d_tpu/io/fixture.py")
+    assert not any(v.rule == "JX008"
+                   for v in _failing(ok, "cup3d_tpu/io/fixture.py"))
     # the obs layer itself is exempt — it IS the span implementation
-    assert not _failing(src, "cup3d_tpu/obs/fixture.py")
+    assert not any(v.rule == "JX008"
+                   for v in _failing(src, "cup3d_tpu/obs/fixture.py"))
     # bench.py / validation harnesses (outside the package) are exempt
     assert not any(v.rule == "JX008" for v in _failing(src, "bench.py"))
 
@@ -937,6 +941,90 @@ def test_jx019_package_is_clean():
     assert out.returncode == 0, out.stdout + out.stderr
 
 
+def test_jx020_raw_clock_fires_suppresses_and_scopes():
+    """Raw clock read outside obs/trace.py (round 22): a stray
+    time.monotonic() is a second clock domain — its intervals cannot
+    be subtracted against trace timestamps without silent skew, which
+    would break the phase-decomposition partition invariant."""
+    mono = (
+        "import time\n"
+        "def f():\n"
+        "    return time.monotonic()\n"
+    )
+    vs = _failing(mono)
+    assert _rules(vs) == {"JX020"} and len(vs) == 1
+    assert "obs.trace.now()" in vs[0].message
+    # bare names from `from time import ...` resolve, aliased or not
+    bare = (
+        "from time import monotonic as mono\n"
+        "def f():\n"
+        "    return mono()\n"
+    )
+    assert _rules(_failing(bare)) == {"JX020"}
+    # an aliased module import and the *_ns variants resolve too
+    ns = (
+        "import time as T\n"
+        "def f():\n"
+        "    return T.time_ns()\n"
+    )
+    assert _rules(_failing(ns)) == {"JX020"}
+    # perf_counter is double-jeopardy by design: JX008 (private timing
+    # channel) and JX020 (clock domain) both fire
+    pc = (
+        "import time\n"
+        "def f():\n"
+        "    return time.perf_counter()\n"
+    )
+    assert "JX020" in _rules(_failing(pc))
+    # one finding per function: the first read covers the section
+    two = (
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.monotonic()\n"
+        "    work()\n"
+        "    return time.monotonic() - t0\n"
+    )
+    assert len([v for v in _failing(two) if v.rule == "JX020"]) == 1
+    # module-level reads fire too
+    toplevel = "import time\nSTART = time.monotonic()\n"
+    assert "JX020" in _rules(_failing(toplevel))
+    # the clock seam itself is path-exempt; outside the package the
+    # rule never engages (bench.py is a timing harness)
+    assert not _failing(mono, "cup3d_tpu/obs/trace.py")
+    assert not _failing(mono, "bench.py")
+    # the sanctioned route never fires (no time-module read at all)
+    sanctioned = (
+        "from cup3d_tpu.obs import trace as OT\n"
+        "def f():\n"
+        "    return OT.now()\n"
+    )
+    assert not _failing(sanctioned)
+    # annotation suppresses with the reason recorded
+    ok = mono.replace(
+        "    return time.monotonic()",
+        "    # jax-lint: allow(JX020, third-party API needs its epoch)\n"
+        "    return time.monotonic()",
+    )
+    all_vs = L.lint_source(ok, HOT)
+    assert not [v for v in L.failing(all_vs) if v.rule == "JX020"]
+    assert any(
+        v.rule == "JX020" and v.suppressed and
+        v.suppression_reason == "third-party API needs its epoch"
+        for v in all_vs)
+
+
+def test_jx020_package_is_clean():
+    """The burn-down stays burned down: every clock read in the
+    package routes through obs.trace.now()/wall() — baseline EMPTY
+    for this rule."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cup3d_tpu.analysis", "--rules", "JX020",
+         "--no-baseline", "cup3d_tpu/", "-q"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
 def test_jx014_wallclock_duration_fires_and_suppresses():
     """Wall-clock subtraction used as a duration (round 16): NTP slews
     and steps time.time(), so a latency computed from it can go
@@ -946,7 +1034,7 @@ def test_jx014_wallclock_duration_fires_and_suppresses():
         "def f(t0):\n"
         "    return time.time() - t0\n"
     )
-    vs = _failing(direct)
+    vs = [v for v in _failing(direct) if v.rule == "JX014"]
     assert _rules(vs) == {"JX014"}
     assert "monotonic" in vs[0].message
     # names assigned from wall-clock reads are tainted transitively
@@ -958,14 +1046,14 @@ def test_jx014_wallclock_duration_fires_and_suppresses():
         "    t1 = time.time()\n"
         "    return t1 - t0\n"
     )
-    assert _rules(_failing(tainted)) == {"JX014"}
+    assert "JX014" in _rules(_failing(tainted))
     # `from time import time` leaves a bare name behind; still resolved
     bare = (
         "from time import time\n"
         "def f(start):\n"
         "    return time() - start\n"
     )
-    assert _rules(_failing(bare)) == {"JX014"}
+    assert "JX014" in _rules(_failing(bare))
     # datetime.now() differences are the same hazard
     dt = (
         "import datetime\n"
@@ -981,7 +1069,7 @@ def test_jx014_wallclock_duration_fires_and_suppresses():
         "        self.t0 = time.time()\n"
         "        return time.time() - self.t0\n"
     )
-    assert _rules(_failing(attr)) == {"JX014"}
+    assert "JX014" in _rules(_failing(attr))
     # annotation suppresses with the reason recorded
     ok = direct.replace(
         "    return time.time() - t0",
@@ -989,7 +1077,7 @@ def test_jx014_wallclock_duration_fires_and_suppresses():
         "    return time.time() - t0",
     )
     all_vs = L.lint_source(ok, HOT)
-    assert not L.failing(all_vs)
+    assert not [v for v in L.failing(all_vs) if v.rule == "JX014"]
     assert any(v.rule == "JX014" and "test fixture" in
                (v.suppression_reason or "") for v in all_vs)
 

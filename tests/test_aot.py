@@ -207,6 +207,62 @@ def test_warm_boot_zero_advance_compiles(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
+def test_compile_wait_phase_cold_then_warm(tmp_path, monkeypatch):
+    """Round-22 provenance through the AOT seam: a cold background
+    build parks its jobs in a nonzero compile_wait phase and leaves a
+    pid-5 compile-service span flow-linked to the jobs' lane spans; a
+    warm boot against the same store never opens the phase at all."""
+    from cup3d_tpu.fleet.server import FleetServer
+    from cup3d_tpu.obs import trace as OT
+
+    monkeypatch.setenv("CUP3D_AOT_STORE", str(tmp_path / "store"))
+    td = str(tmp_path / "trace")
+    OT.TRACE.configure(enabled=True, directory=td)
+    try:
+        srv1 = FleetServer(workdir=str(tmp_path / "wd1"))
+        ids = [srv1.submit(f"t{i}", _tgv_spec()) for i in range(2)]
+        srv1.drain()
+        OT.TRACE.close()
+    finally:
+        OT.TRACE.configure(enabled=False)
+    assert all(srv1._jobs[j].status == "done" for j in ids)
+    cold = {j: srv1._jobs[j].phases().get("compile_wait", 0.0)
+            for j in ids}
+    assert max(cold.values()) > 0, cold
+    # the decomposition still partitions e2e with the new phase present
+    for j in ids:
+        phases = srv1._jobs[j].phases()
+        times = [t for _, t in srv1._jobs[j].events]
+        assert sum(phases.values()) == pytest.approx(
+            times[-1] - times[0], rel=1e-9, abs=1e-12)
+    # cross-subsystem flow: compile-service span on pid 5, flow start
+    # ("s") at the build, flow finish ("f") on a waiting job's lane span
+    with open(os.path.join(td, "trace.pfto.json")) as f:
+        events = json.load(f)["traceEvents"]
+    compile_track = [e for e in events if e.get("pid") == OT.COMPILE_PID]
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in compile_track)
+    spans = [e for e in compile_track if e["ph"] == "X"]
+    assert spans and all(e["args"]["outcome"] == "done" for e in spans)
+    starts = {e["id"] for e in events
+              if e.get("ph") == "s" and e.get("cat") == "flow"}
+    finishes = {e["id"] for e in events
+                if e.get("ph") == "f" and e.get("cat") == "flow"}
+    waited = {j for j, v in cold.items() if v > 0}
+    assert waited <= starts and finishes <= starts
+    assert finishes & waited  # at least one arrow lands on a lane span
+
+    # warm boot: the signature deserializes — nobody waits on a compile
+    srv2 = FleetServer(workdir=str(tmp_path / "wd2"))
+    ids2 = [srv2.submit(f"t{i}", _tgv_spec()) for i in range(2)]
+    srv2.drain()
+    assert all(srv2._jobs[j].status == "done" for j in ids2)
+    for j in ids2:
+        assert srv2._jobs[j].phases().get("compile_wait", 0.0) == 0.0
+        assert srv2._jobs[j].event_time("compile_wait") is None
+
+
+@pytest.mark.slow
 def test_health_reports_aot_state(tmp_path, monkeypatch):
     from cup3d_tpu.fleet.server import FleetServer
 

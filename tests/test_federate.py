@@ -79,6 +79,50 @@ def test_federated_quantiles_exactly_equal_merged_quantile():
     assert max(h.max for h in group) == ref.max
 
 
+def test_federated_phase_histograms_merge_exactly():
+    """Round-22 provenance federates for free: per-phase latency
+    histograms (fleet.latency_phase_s{phase,tenant}) from N process
+    snapshots merge to exactly the quantiles one fleet-wide registry
+    would report — per phase label-set, no cross-phase bleed."""
+    from cup3d_tpu.obs import trace as OT
+
+    parts = _latency_parts(nproc=3, per=64, seed=23)
+    phases = ("compile_wait", "dispatch")
+    snaps = []
+    for p, vals in enumerate(parts):
+        reg = M.MetricsRegistry()
+        for ph in phases:
+            h = reg.histogram("fleet.latency_phase_s", phase=ph,
+                              tenant="acme")
+            scale = 0.1 if ph == "compile_wait" else 1.0
+            for v in vals:
+                h.observe(float(v) * scale)
+        snaps.append(FD.local_snapshot(reg, process=p))
+    view = FD.merge_snapshots(snaps)
+    for ph in phases:
+        assert ph in OT.JOB_PHASES
+        scale = 0.1 if ph == "compile_wait" else 1.0
+        ref = M.MetricsRegistry().histogram(
+            "fleet.latency_phase_s", phase=ph, tenant="acme")
+        for vals in parts:
+            for v in vals:
+                ref.observe(float(v) * scale)
+        group = view.merged("fleet.latency_phase_s", phase=ph,
+                            tenant="acme")
+        assert len(group) == 3
+        for q in (0.5, 0.99):
+            fed = view.quantile("fleet.latency_phase_s", q, phase=ph,
+                                tenant="acme")
+            assert fed == M.merged_quantile(group, q)
+            assert fed == ref.quantile(q)
+    # the convenience view: one dict keyed by phase, exact per entry
+    pq = view.phase_quantiles(tenant="acme")
+    assert set(pq) == set(phases)
+    for ph in phases:
+        assert pq[ph]["p99"] == view.quantile(
+            "fleet.latency_phase_s", 0.99, phase=ph, tenant="acme")
+
+
 def test_counter_and_gauge_merge_semantics():
     """Counters sum across processes; gauges stay per-process under a
     process=i label (a queue depth is not summable)."""

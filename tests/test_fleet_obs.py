@@ -223,3 +223,133 @@ def test_burn_rate_fires_when_latency_exceeds_slo(tmp_path):
     assert burny["quantiles"]["p99"] > 1e-6
     health = srv.health()
     assert health["slo"]["tenants"]["burny"]["breaches"] == 1
+
+
+# -- latency provenance (round 22) ------------------------------------------
+
+
+def _assert_partition(rec):
+    """The partition invariant: the phases block uses only catalog
+    phases, is non-negative, and sums to the event span exactly (float
+    eps) — no leftover, no double counting."""
+    phases = rec["phases"]
+    assert phases and set(phases) <= set(OT.JOB_PHASES)
+    assert all(v >= 0.0 for v in phases.values())
+    times = [t for _, t in rec["events"]]
+    span = times[-1] - times[0]
+    assert sum(phases.values()) == pytest.approx(span, rel=1e-9, abs=1e-12)
+
+
+def test_phase_decomposition_partitions_e2e(drained):
+    """Every terminal job record carries a phases block summing to its
+    event span — done and cancelled fates alike; a job that never ran
+    has no dispatch mass."""
+    srv, done_ids, cancel_id, td = drained
+    jobs = {r["job_id"]: r for r in _job_records(td)}
+    for job_id in done_ids:
+        _assert_partition(jobs[job_id])
+        assert jobs[job_id]["phases"]["dispatch"] > 0
+    cancelled = jobs[cancel_id]
+    _assert_partition(cancelled)
+    assert "dispatch" not in cancelled["phases"]
+    # the live-server view agrees with the trace record
+    for job_id in done_ids:
+        live = srv._jobs[job_id].phases()
+        assert live == pytest.approx(jobs[job_id]["phases"])
+
+
+def test_phase_decomposition_requeue_and_unknown_events():
+    """The pure decomposition on a requeued-after-shard-loss timeline:
+    the loss->requeue gap lands in rollback_retry, the second queue
+    stretch back in capacity_wait, and the partition still closes.
+    Unknown event names degrade to the retire bucket, never crash."""
+    events = [("submitted", 0.0), ("queued", 0.5), ("bucketed", 1.0),
+              ("running", 1.5), ("shard_lost", 2.0), ("queued", 2.25),
+              ("running", 3.0), ("retire", 3.5), ("done", 3.75)]
+    ph = OT.phase_decomposition(events)
+    assert sum(ph.values()) == pytest.approx(3.75)
+    assert ph["rollback_retry"] == pytest.approx(0.25)
+    assert ph["capacity_wait"] == pytest.approx(1.25)  # both waits
+    assert ph["dispatch"] == pytest.approx(1.0)        # both runs
+    assert ph["admission"] == pytest.approx(0.5)
+    assert ph["assembly"] == pytest.approx(0.5)
+    assert ph["retire"] == pytest.approx(0.25)
+    weird = OT.phase_decomposition(
+        [("submitted", 0.0), ("comet_strike", 1.0), ("done", 2.0)])
+    assert weird["retire"] == pytest.approx(1.0)
+    assert sum(weird.values()) == pytest.approx(2.0)
+
+
+def test_failed_job_partitions_with_rollback_mass(tmp_path):
+    """A lane that faults past its retry budget retires FAILED with a
+    phases block whose rollback_retry mass is nonzero — and the
+    partition invariant holds on the failed fate too."""
+    td = str(tmp_path)
+    OT.TRACE.configure(enabled=True, directory=td)
+    try:
+        faults.arm("fleet.lane_nan", 1, 99)
+        srv = FleetServer(workdir=os.path.join(td, "wd"),
+                          max_retries=2, snap_every=4)
+        ids = [srv.submit("t0", _tgv_spec(cfl=0.3, nsteps=12)),
+               srv.submit("t1", _tgv_spec(cfl=0.28, nsteps=12))]
+        srv.drain()
+        OT.TRACE.close()
+    finally:
+        OT.TRACE.configure(enabled=False)
+    jobs = {r["job_id"]: r for r in _job_records(td)}
+    assert jobs[ids[1]]["status"] == "failed"
+    _assert_partition(jobs[ids[1]])
+    assert jobs[ids[1]]["phases"]["rollback_retry"] > 0
+    _assert_partition(jobs[ids[0]])
+    assert "rollback_retry" not in jobs[ids[0]]["phases"]
+
+
+def test_burn_attribution_names_dominant_phase(tmp_path):
+    """With every job breaching, slo_status attaches the per-tenant
+    burn attribution: phase shares sum to 1, the dominant phase is a
+    catalog phase, and the per-phase quantiles are coherent."""
+    srv = FleetServer(workdir=str(tmp_path), slo_p99_s=1e-6,
+                      slo_window=10)
+    # warm the signature under a throwaway tenant so the measured
+    # job's assembly phase is a cache hit — otherwise the XLA compile
+    # lands in assembly and can out-weigh dispatch on a loaded machine
+    srv.submit("warmup", _tgv_spec(cfl=0.3))
+    srv.drain()
+    srv.submit("burny", _tgv_spec(cfl=0.3))
+    srv.drain()
+    attr = srv.slo_status()["tenants"]["burny"]["attribution"]
+    assert attr["dominant_phase"] in OT.JOB_PHASES
+    shares = {ph: d["share"] for ph, d in attr["phases"].items()}
+    # shares are reported rounded to 4 decimals — allow one rounding
+    # ulp per phase in the sum
+    assert sum(shares.values()) == pytest.approx(
+        1.0, abs=5e-4 * len(OT.JOB_PHASES))
+    assert attr["dominant_phase"] == max(shares, key=shares.get)
+    for ph, d in attr["phases"].items():
+        assert ph in OT.JOB_PHASES
+        assert 0 <= d["share"] <= 1
+        # a phase with window mass has a quantile; unseen phases (share
+        # 0) report None, not a fabricated number
+        if d["share"] > 0:
+            assert d["p99_s"] >= 0
+    # the dispatch phase dominates a healthy single-job drain (the
+    # compute IS the latency here)
+    assert attr["dominant_phase"] == "dispatch"
+    pq = srv.phase_quantiles(tenant="burny")
+    assert pq["dispatch"]["p99"] > 0
+    assert set(pq) <= set(OT.JOB_PHASES)
+
+
+def test_provenance_knob_disables_phase_records(tmp_path):
+    """CUP3D_FLEET_PROVENANCE=0 / provenance=False: no phase
+    histograms, no share history — the decomposition stays available
+    on demand via job.phases()."""
+    s0 = M.snapshot()
+    srv = FleetServer(workdir=str(tmp_path), provenance=False)
+    jid = srv.submit("quiet", _tgv_spec())
+    srv.drain()
+    d = M.delta(s0)
+    assert not any(v for k, v in d.items()
+                   if k.startswith("fleet.latency_phase_s"))
+    assert srv._phase_share_history == {}
+    assert sum(srv._jobs[jid].phases().values()) > 0

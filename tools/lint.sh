@@ -1,11 +1,12 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX019
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX020
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
 # swallowed-exception, JX011 bf16-reduction-accumulator, JX012
 # profiler-outside-obs, JX013 per-lane-loop, JX014
 # wall-clock-duration, JX015 per-tick-batch-reassembly, JX016
 # sharded-materialization, JX017 hand-typed-hardware-peak, JX018
-# raw-collective-outside-parallel/ and JX019 aot-seam rules)
+# raw-collective-outside-parallel/, JX019 aot-seam and JX020
+# raw-clock-outside-trace rules)
 # + the IR audit (rules JP001-JP005: traced jaxprs + AOT alias maps of
 #   the canonical entry points, `python -m cup3d_tpu.analysis audit`)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
@@ -103,6 +104,15 @@ python -m cup3d_tpu.analysis --rules JX018 cup3d_tpu/ -q
 # signatures deserialize at boot instead of recompiling
 echo "== python -m cup3d_tpu.analysis --rules JX019 cup3d_tpu/"
 python -m cup3d_tpu.analysis --rules JX019 cup3d_tpu/ -q
+
+# the clock-domain rule on its own line (round 22): a raw
+# time.monotonic()/time.time()/perf_counter() call site outside
+# obs/trace.py fails CI identifiably — the latency-provenance phase
+# decomposition partitions e2e only because every lifecycle timestamp
+# comes off the one monotonic clock behind obs.trace.now() (wall
+# stamps: obs.trace.wall())
+echo "== python -m cup3d_tpu.analysis --rules JX020 cup3d_tpu/"
+python -m cup3d_tpu.analysis --rules JX020 cup3d_tpu/ -q
 
 # the IR audit (round 20): trace + AOT-lower the canonical entry points
 # (uniform/fish/AMR megaloops, fleet advance+reseed, mesh-sharded

@@ -6,7 +6,8 @@ Reads the append-only JSONL store ``bench.py`` writes after every run
 ``DEFAULT_SPECS`` set: ``cells_per_s``, ``bicgstab_iter_device_ms``,
 ``wall_per_step_p95_s``, ``fleet_cells_per_s``, ``amr_cells_per_s``,
 ``amr_bicgstab_iter_device_ms``, ``fleet_job_p99_s``,
-``fleet_occupancy``, ``mesh_cells_per_s``), compares the newest value
+``fleet_occupancy``, ``fleet_compile_wait_frac``,
+``mesh_cells_per_s``), compares the newest value
 against the
 median of the previous N — the BENCH_r0x snapshots as a
 machine-checkable time series.
@@ -92,8 +93,13 @@ def selftest() -> None:
                 # tail latency RISES when the run slows down
                 "fleet_slo": {"fleet_job_p99_s": 2.0 / amr_scale},
                 # round 17: lane occupancy of the continuous-batching
-                # fleet_skew config — DROPS when reseeding degrades
-                "fleet_skew": {"fleet_occupancy": 0.8 * amr_scale},
+                # fleet_skew config — DROPS when reseeding degrades.
+                # Round 22: the compile_wait share of total phase time
+                # rides the same config — RISES when jobs start
+                # stalling on XLA compiles again
+                "fleet_skew": {"fleet_occupancy": 0.8 * amr_scale,
+                               "fleet_compile_wait_frac":
+                                   0.05 / amr_scale},
                 # round 18: sharded megaloop throughput of the mesh2d
                 # scale-out config — DROPS when the slab path regresses
                 "mesh2d": {"mesh_cells_per_s": 4.0e6 * amr_scale},
@@ -123,6 +129,7 @@ def selftest() -> None:
                      "wall_per_step_p95_s", "fleet_cells_per_s",
                      "amr_cells_per_s", "amr_bicgstab_iter_device_ms",
                      "fleet_job_p99_s", "fleet_occupancy",
+                     "fleet_compile_wait_frac",
                      "mesh_cells_per_s", "fish_bicgstab_bytes_compiler",
                      "warm_start_s"):
             assert by[name]["regressed"], (name, by[name])

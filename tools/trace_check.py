@@ -34,7 +34,16 @@ Checks, per ``cup3d_tpu.obs.trace`` schema version %d:
   job at a time, so overlap means the emission is lying;
 - per-shard K-boundary tracks (pid 4, round 19: obs/federate.py
   straggler watch) need their own ``process_name`` metadata event and
-  a ``shard`` arg on every boundary span.
+  a ``shard`` arg on every boundary span;
+- round 22 (latency provenance): an optional ``phases`` block on a
+  ``kind="job"`` record must name only known phases, carry nonnegative
+  numbers, and SUM to the event-timeline span (the partition
+  invariant) — validated in obs/trace.py, exercised here with teeth;
+  compile-service spans (pid 5, aot/compiler.py) need their own
+  ``process_name`` metadata event and an ``outcome`` arg; Perfetto
+  flow events (``ph:"s"``/``"f"``) need ``cat``/``id``, and every
+  flow FINISH must pair with an earlier flow START of the same id
+  (the compile->lane causal arrows).
 
 ``--selftest`` (what ``tools/lint.sh`` runs, no simulation needed)
 drives a private TraceSink through spans + step records in a temp dir,
@@ -107,11 +116,41 @@ def _check_chrome(obj: dict, origin: str, want_steps: int) -> int:
     lane_named = False
     shard_named = False
     shard_spans = 0
+    compile_named = False
+    compile_spans = 0
     lane_spans = {}  # tid -> [(ts, dur)] job-occupancy spans
+    flow_starts = {}  # id -> [ts] of ph:"s" events
+    flow_ends = {}  # id -> [ts] of ph:"f" events
     for e in events:
         for k in ("name", "ph", "ts"):
             if k not in e:
                 raise SystemExit(f"{origin}: event missing {k!r}: {e}")
+        if e["ph"] in ("s", "f"):
+            # round 22: flow events ride the lane/compile pids, so this
+            # check must come before the per-pid span branches
+            if e.get("cat") != "flow" or "id" not in e:
+                raise SystemExit(
+                    f"{origin}: flow event without cat/id: {e}"
+                )
+            side = flow_starts if e["ph"] == "s" else flow_ends
+            side.setdefault(str(e["id"]), []).append(float(e["ts"]))
+            continue
+        if e.get("pid") == obs_trace.COMPILE_PID:
+            # round 22: background compile-service track
+            if e["ph"] == "M" and e["name"] == "process_name":
+                compile_named = True
+                continue
+            if e["ph"] != "X":
+                continue
+            if "dur" not in e:
+                raise SystemExit(
+                    f"{origin}: compile span without dur: {e}")
+            if "outcome" not in e.get("args", {}):
+                raise SystemExit(
+                    f"{origin}: compile span without outcome arg: {e}"
+                )
+            compile_spans += 1
+            continue
         if e.get("pid") == obs_trace.SHARD_PID:
             # round 19: per-shard K-boundary tracks (obs/federate.py)
             if e["ph"] == "M" and e["name"] == "process_name":
@@ -179,6 +218,23 @@ def _check_chrome(obj: dict, origin: str, want_steps: int) -> int:
             f"{origin}: shard spans present but no process_name "
             f"metadata for pid {obs_trace.SHARD_PID}"
         )
+    if compile_spans and not compile_named:
+        raise SystemExit(
+            f"{origin}: compile spans present but no process_name "
+            f"metadata for pid {obs_trace.COMPILE_PID}"
+        )
+    for fid, ends in flow_ends.items():
+        starts = flow_starts.get(fid)
+        if not starts:
+            raise SystemExit(
+                f"{origin}: flow finish without a start for id {fid!r}"
+            )
+        for tf in ends:
+            if not any(ts <= tf + 1e-6 for ts in starts):
+                raise SystemExit(
+                    f"{origin}: flow finish at {tf} precedes every "
+                    f"start of id {fid!r} — causality inverted"
+                )
     for tid, spans in lane_spans.items():
         spans.sort()
         for (ts0, dur0), (ts1, _) in zip(spans, spans[1:]):
@@ -352,8 +408,89 @@ def selftest() -> None:
             assert "wall_s" in str(e), e
         else:
             raise AssertionError("malformed shard record not caught")
+    # round 22: latency provenance — a job record carrying its phases
+    # block, a pid-5 compile span, and the compile->lane flow arrows
+    # produced through the same sink APIs aot/compiler.py +
+    # fleet/server.py use must validate end to end; the phases
+    # partition check and the flow pairing check must both have teeth
+    with tempfile.TemporaryDirectory() as td:
+        sink = obs_trace.TraceSink(enabled=True, directory=td)
+        timer = obs_trace.SpanTimer(sink=sink)
+        obsr = obs_trace.StepObserver(timer, kind="selftest")
+        with obsr.step(0, 0.0, 0.1):
+            pass
+        t0 = obs_trace.now()
+        events = [("submitted", t0), ("queued", t0 + 0.001),
+                  ("bucketed", t0 + 0.002),
+                  ("compile_wait", t0 + 0.003),
+                  ("compile_ready", t0 + 0.010),
+                  ("running", t0 + 0.011), ("retire", t0 + 0.020),
+                  ("done", t0 + 0.021)]
+        phases = obs_trace.phase_decomposition(events)
+        sink.aux(obs_trace.job_record(
+            "job-c", "tenant-a", "done", 8, events, bucket="tgv-abc",
+            phases=phases))
+        sink.compile_span(1, "fleet.advance-deadbeef", t0 + 0.004,
+                          0.006, args={"outcome": "done",
+                                       "jobs": ["job-c"]})
+        sink.flow_start("job-c", "compile->lane", t0 + 0.010,
+                        obs_trace.COMPILE_PID, 1)
+        sink.lane_span(0, "job-c", t0 + 0.011, 0.010,
+                       args={"job_id": "job-c", "status": "done"})
+        sink.flow_finish("job-c", "compile->lane", t0 + 0.011,
+                         obs_trace.LANE_PID, 0)
+        sink.close()
+        records = validate_jsonl(os.path.join(td, "trace.jsonl"))
+        jobs = [r for r in records if r.get("kind") == "job"]
+        assert len(jobs) == 1 and "phases" in jobs[0], jobs
+        span = events[-1][1] - events[0][1]
+        assert abs(sum(phases.values()) - span) <= 1e-9, (phases, span)
+        with open(os.path.join(td, "trace.pfto.json")) as f:
+            merged = json.load(f)
+        _check_chrome(merged, "<provenance export>", 1)
+        # teeth 1: a NON-PARTITIONING phases block (sum != event span)
+        # must fail the jsonl validation identifiably
+        bad_rec = obs_trace.job_record(
+            "job-x", "tenant-a", "done", 8, events,
+            phases={"dispatch": 999.0})
+        bad_rec["schema"] = obs_trace.SCHEMA_VERSION
+        bad_path = os.path.join(td, "bad_phases.jsonl")
+        with open(bad_path, "w") as f:
+            f.write(json.dumps(bad_rec) + "\n")
+        try:
+            validate_jsonl(bad_path)
+        except SystemExit as e:
+            assert "partition" in str(e), e
+        else:
+            raise AssertionError("non-partitioning phases not caught")
+        # teeth 2: an unknown phase name must fail too
+        bad_rec2 = obs_trace.job_record(
+            "job-y", "tenant-a", "done", 8, events,
+            phases={"limbo": 0.021})
+        bad_rec2["schema"] = obs_trace.SCHEMA_VERSION
+        bad_path2 = os.path.join(td, "bad_phase_name.jsonl")
+        with open(bad_path2, "w") as f:
+            f.write(json.dumps(bad_rec2) + "\n")
+        try:
+            validate_jsonl(bad_path2)
+        except SystemExit as e:
+            assert "JOB_PHASES" in str(e), e
+        else:
+            raise AssertionError("unknown phase name not caught")
+        # teeth 3: a flow finish whose start was dropped must fail the
+        # chrome check identifiably
+        orphan = json.loads(json.dumps(merged))
+        orphan["traceEvents"] = [
+            e for e in orphan["traceEvents"] if e.get("ph") != "s"]
+        try:
+            _check_chrome(orphan, "<orphan flow probe>", 1)
+        except SystemExit as e:
+            assert "flow finish without a start" in str(e), e
+        else:
+            raise AssertionError("orphaned flow finish not caught")
     print("trace_check selftest: OK (incl. merged host+device, "
-          "job records + lane tracks, shard boundary tracks)")
+          "job records + lane tracks, shard boundary tracks, "
+          "phase partitions + compile flows)")
 
 
 def main(argv=None) -> int:
