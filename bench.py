@@ -31,7 +31,7 @@ traffic and Krylov allreduces).  Raw records:
 validation/results/baseline.jsonl.
 
 Env knobs: CUP3D_BENCH_CONFIG=fish|tgv|spectral|amr|fleet|fleet_slo|
-fleet_skew|mesh2d|cold_start|all (default all),
+fleet_skew|mesh2d|cold_start|durability|all (default all),
 CUP3D_BENCH_N (downscale resolutions for CPU smoke testing),
 CUP3D_BENCH_PROFILE=<dir> (capture a jax.profiler trace of the timed
 region of each config for TensorBoard / xprof).
@@ -469,6 +469,99 @@ def _provenance_overhead(lanes: int, n: int, gate: float = 1.03):
             ratio_min <= gate and book_fraction <= gate - 1.0),
         "provenance_bookkeeping_per_job_s": round(book_job, 6),
         "provenance_bookkeeping_fraction": round(book_fraction, 4),
+    }
+
+
+def _journal_overhead(lanes: int, n: int, gate: float = 1.03):
+    """Round-23 journal-overhead gate: draining the SAME seeded job set
+    with the write-ahead journal ON (submit/place/terminal records +
+    K-boundary carry snapshots) must stay within ``gate`` (3%) of the
+    journal-OFF drain (``CUP3D_FLEET_JOURNAL=0``, the bitwise-legacy
+    path).  Method mirrors :func:`_provenance_overhead`: four ADJACENT
+    (off, on) drain pairs in alternating order, MINIMUM pair ratio as
+    the least-contaminated window estimate — ANDed with a directly-
+    timed append block (re-write the ON drain's record count against a
+    throwaway journal, the exact disk work the knob adds) as the
+    second estimator: a real regression moves both, a noisy machine
+    moves only the windows."""
+    import tempfile
+
+    from cup3d_tpu.fleet.journal import JobJournal
+    from cup3d_tpu.fleet.server import FleetServer
+    from cup3d_tpu.obs import metrics as obs_metrics
+
+    steps = [8, 8, 8, 8]
+
+    def timed_drain(journal, tag):
+        srv = FleetServer(
+            max_lanes=lanes, snap_every=8, journal=journal,
+            workdir=tempfile.mkdtemp(prefix=f"cup3d-benchjrn-{tag}-"))
+        # prime the signature rung so the windows time scheduling +
+        # dispatch + journal appends, not XLA compiles
+        srv.submit("warmup", dict(kind="tgv", n=n, nsteps=8, cfl=0.3))
+        srv.drain()
+        # jax-lint: allow(JX006, drain() settles every dispatch before
+        # returning — all lane-step QoI rows are host-read inside the
+        # window)
+        t0 = time.perf_counter()
+        ids = [srv.submit("jrn", dict(kind="tgv", n=n, nsteps=s,
+                                      cfl=0.3)) for s in steps]
+        srv.drain()
+        # jax-lint: allow(JX006, the drain() above settled every
+        # dispatch)
+        wall = time.perf_counter() - t0
+        return wall, srv, ids
+
+    pairs, offs, ons = [], [], []
+    appends = 0
+    sample_rec = None
+    for k in range(4):
+        order = (False, True) if k % 2 == 0 else (True, False)
+        walls = {}
+        for jrn in order:
+            tag = "on" if jrn else "off"
+            s0 = obs_metrics.snapshot() if jrn else None
+            wall, srv, ids = timed_drain(jrn, f"{tag}{k}")
+            walls[tag] = wall
+            if jrn:
+                d = obs_metrics.delta(s0)
+                appends = int(sum(v for key, v in d.items()
+                                  if key.startswith("journal.appends{")))
+                job = srv._jobs[ids[0]]
+                sample_rec = dict(
+                    job_id=job.job_id, status=job.status,
+                    steps_done=job.steps_done, time=job.time,
+                    nsteps=job.nsteps, rows=job.rows.copy())
+        offs.append(walls["off"])
+        ons.append(walls["on"])
+        pairs.append(walls["on"] / max(walls["off"], 1e-12))
+    # direct estimator: replay the ON drain's append count against a
+    # throwaway journal with a real terminal-sized record and time
+    # just the disk work
+    probe = JobJournal(tempfile.mkdtemp(prefix="cup3d-benchjrn-probe-"))
+    # jax-lint: allow(JX006, pure host+disk window — journal appends
+    # dispatch nothing to the device)
+    t0 = time.perf_counter()
+    for _ in range(max(1, appends)):
+        probe.append("terminal", **sample_rec)
+    # jax-lint: allow(JX006, same pure host+disk window as above)
+    append_s = time.perf_counter() - t0
+    ratio = float(np.median(pairs))
+    ratio_min = float(min(pairs))
+    wall_off = min(offs)
+    append_fraction = append_s / max(wall_off, 1e-12)
+    return {
+        "wall_drain_journal_s": round(min(ons), 4),
+        "wall_drain_nojournal_s": round(wall_off, 4),
+        "journal_pair_ratios": [round(r, 4) for r in pairs],
+        "journal_overhead_ratio": round(ratio, 4),
+        "journal_overhead_ratio_min": round(ratio_min, 4),
+        "journal_overhead_gate": gate,
+        "journal_overhead_gate_ok": bool(
+            ratio_min <= gate and append_fraction <= gate - 1.0),
+        "journal_appends_per_drain": appends,
+        "journal_append_window_s": round(append_s, 6),
+        "journal_append_fraction": round(append_fraction, 4),
     }
 
 
@@ -1983,11 +2076,97 @@ def bench_cold_start():
     }
 
 
+def bench_durability():
+    """Round-23 durable-serving config: the crash-restart drill as a
+    benchmark.  Three subprocesses against one shared executable store:
+    an unfaulted journal-OFF control (the bitwise-legacy baseline, and
+    the store warmer), a journal-ON serve killed hard
+    (``CUP3D_FAULT=server.crash@1`` -> ``os._exit(23)``) at its first
+    K-boundary dispatch, and a ``python -m cup3d_tpu fleet recover``
+    restart that replays the journal and finishes every job.
+
+    Headline metric: ``recover_restart_s`` — CLI entry to the restarted
+    server's first dispatch (history.py tracks it lower-is-better).
+    Acceptance bars riding the same run: zero lost jobs, the recovered
+    QoI digest bitwise-equal to the control, ZERO advance compiles on
+    the restart (the store stayed warm through the crash), and the
+    in-process journal-overhead gate (adjacent on/off drain pairs,
+    ``_journal_overhead``, <= 3%)."""
+    import subprocess
+    import sys
+    import tempfile
+
+    njobs = int(os.environ.get("CUP3D_BENCH_DRILL_JOBS", "2"))
+    nsteps = int(os.environ.get("CUP3D_BENCH_DRILL_STEPS", "24"))
+    n = _scaled(16)
+    root = tempfile.mkdtemp(prefix="cup3d-benchdrill-")
+    spec_path = os.path.join(root, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump([dict(kind="tgv", n=n, nsteps=nsteps, cfl=0.3,
+                        tenant=f"drill-{i}") for i in range(njobs)], f)
+    drill = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "chaosdrill.py")
+    base = dict(os.environ, CUP3D_AOT_STORE=os.path.join(root, "store"),
+                CUP3D_SNAP_EVERY="8")
+    base.pop("CUP3D_FAULT", None)
+
+    def run(cmd, env, want_rc):
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             env=env, timeout=1200)
+        # jax-lint: allow(JX003, host-side subprocess driver — want_rc
+        # is a plain int exit code, nothing here is traced)
+        if out.returncode != want_rc:
+            raise RuntimeError(
+                f"{cmd[-1]} rc={out.returncode} (wanted {want_rc}): "
+                + (out.stderr or out.stdout)[-300:])
+        return out
+
+    ctl = json.loads(run(
+        [sys.executable, drill, "_serve",
+         "--workdir", os.path.join(root, "ctl"), "--spec", spec_path,
+         "--lanes", "4", "--snap-every", "8", "--journal", "0"],
+        base, 0).stdout)
+    run([sys.executable, drill, "_serve",
+         "--workdir", os.path.join(root, "crash"), "--spec", spec_path,
+         "--lanes", "4", "--snap-every", "8", "--journal", "1"],
+        dict(base, CUP3D_FAULT="server.crash@1"), 23)
+    report = json.loads(run(
+        [sys.executable, "-m", "cup3d_tpu", "fleet", "recover",
+         "--workdir", os.path.join(root, "crash"), "--lanes", "4"],
+        base, 0).stdout)
+
+    bitwise = report["rows_blake2s"] == ctl["rows_blake2s"]
+    lost = sorted(set(ctl["jobs"]) - set(report["jobs"]))
+    recompiles = int(report["advance_compiles"])
+    restart_s = report["recover_restart_s"]
+    ok = bool(bitwise and not lost and recompiles == 0
+              and restart_s is not None)
+    out = {
+        "cells_per_s": (njobs * nsteps * n**3
+                        / max(report["total_s"], 1e-9)),
+        "recover_restart_s": (round(float(restart_s), 3)
+                              if restart_s is not None else None),
+        "recover_total_s": round(float(report["total_s"]), 3),
+        "recover_advance_compiles": recompiles,
+        "recovery": report["recovery"],
+        "lost_jobs": lost,
+        "bitwise_equal": bool(bitwise),
+        "recover_gate_ok": ok,
+        "jobs": njobs,
+        "nsteps": nsteps,
+        "n": n,
+    }
+    out.update(_journal_overhead(lanes=4, n=n))
+    return out
+
+
 def main():
     which = os.environ.get("CUP3D_BENCH_CONFIG", "all")
     if which not in ("fish", "fish256", "tgv", "spectral", "amr",
                      "channel", "amr_tgv", "fleet", "fleet_slo",
-                     "fleet_skew", "mesh2d", "cold_start", "all"):
+                     "fleet_skew", "mesh2d", "cold_start", "durability",
+                     "all"):
         print(json.dumps({"metric": "error", "value": 0, "unit": "",
                           "vs_baseline": 0,
                           "error": f"unknown CUP3D_BENCH_CONFIG {which!r}"}))
@@ -2028,13 +2207,15 @@ def main():
         ("fleet_skew", bench_fleet_skew),
         ("mesh2d", bench_mesh2d),
         ("cold_start", bench_cold_start),
+        ("durability", bench_durability),
     ):
         sel = {"fish256": None, "tgv_iterative": "tgv",
                "spectral": "spectral", "two_fish_amr": "amr",
                "channel": "channel", "amr_tgv": "amr_tgv",
                "fleet32": "fleet", "fleet_slo": "fleet_slo",
                "fleet_skew": "fleet_skew", "mesh2d": "mesh2d",
-               "cold_start": "cold_start"}[key]
+               "cold_start": "cold_start",
+               "durability": "durability"}[key]
         if which != "all" and which != sel:
             continue
         try:
@@ -2191,6 +2372,28 @@ def _compact_summary(out: dict) -> dict:
                 "reseeds": d.get("fleet_reseeds"),
                 "gate": d.get("fleet_occupancy_gate"),
                 "ok": d["fleet_occupancy_gate_ok"],
+            }
+        if "journal_overhead_gate_ok" in d:
+            # the round-23 acceptance bar: the write-ahead journal
+            # (lifecycle records + K-boundary carry snapshots) costs
+            # <= 3% of the journal-off drain wall
+            gates[f"{key}_journal_overhead"] = {
+                "ratio": d.get("journal_overhead_ratio"),
+                "ratio_min": d.get("journal_overhead_ratio_min"),
+                "append_fraction": d.get("journal_append_fraction"),
+                "gate": d.get("journal_overhead_gate"),
+                "ok": d["journal_overhead_gate_ok"],
+            }
+        if "recover_gate_ok" in d:
+            # the round-23 acceptance bar: a hard-killed server's
+            # restart loses zero jobs, reproduces the control's QoI
+            # bytes bitwise, and performs zero advance compiles
+            gates["durability_recover"] = {
+                "restart_s": d.get("recover_restart_s"),
+                "advance_compiles": d.get("recover_advance_compiles"),
+                "bitwise": d.get("bitwise_equal"),
+                "lost_jobs": d.get("lost_jobs"),
+                "ok": d["recover_gate_ok"],
             }
         if "cold_start_gate_ok" in d:
             # the round-21 acceptance bar: a warmed executable store

@@ -301,6 +301,17 @@ JX020_EXEMPT_RE = re.compile(r"cup3d_tpu/obs/trace\.py$")
 JX020_CLOCK_ATTRS = ("time", "monotonic", "perf_counter",
                      "time_ns", "monotonic_ns", "perf_counter_ns")
 
+#: JX021 (round 23): the sanctioned fleet job-state seams — the ONLY
+#: functions in cup3d_tpu/fleet/ allowed to assign ``<job>.status``.
+#: Each either journals the transition itself or sits on a path that
+#: funnels into ``_job_terminal``/``mark`` (first assembly, retire,
+#: reseed splice, queued-cancel, prepare-failure, journal replay); a
+#: status flip anywhere else is a lifecycle transition the write-ahead
+#: journal never sees, i.e. a job a crash can silently lose.
+JX021_SANCTIONED_RE = re.compile(
+    r"^(__init__|retire|reseed_lane|cancel|_prepare|"
+    r"_install_replayed_job)$")
+
 
 def _is_power_of_ten(v: float) -> bool:
     if v <= 0:
@@ -575,6 +586,7 @@ class FileLint:
             if JX013_MODULE_RE.search(self.path):
                 self._check_lane_device_loop(func, qualname)  # JX013
                 self._check_batch_reassembly(func, qualname)  # JX015
+                self._check_status_mutation(func, qualname)   # JX021
             if JX016_MODULE_RE.search(self.path):
                 self._check_sharded_materialization(func, qualname)  # JX016
             if not JX017_EXEMPT_RE.search(self.path) and (
@@ -608,6 +620,7 @@ class FileLint:
             self._check_bf16_reduction(self.tree, "<module>")  # JX011
         if JX013_MODULE_RE.search(self.path):
             self._check_lane_device_loop(self.tree, "<module>")  # JX013
+            self._check_status_mutation(self.tree, "<module>")  # JX021
         if JX017_PATH_RE.search(self.path) and not JX017_EXEMPT_RE.search(
             self.path
         ):
@@ -1600,6 +1613,42 @@ class FileLint:
                 "clock domains — use obs.trace.now() for monotonic "
                 "reads or obs.trace.wall() for wall-time stamps",
             )
+
+    # -- JX021 -------------------------------------------------------------
+
+    def _check_status_mutation(self, func: ast.AST,
+                               qualname: str) -> None:
+        """Direct ``<job>.status = ...`` assignment outside the
+        journal-logging seams (JX021, fleet/ only).  Every fleet job
+        state transition must flow through a sanctioned seam
+        (JX021_SANCTIONED_RE: first assembly, retire, reseed splice,
+        cancel, prepare-failure, journal replay) — those are the
+        functions whose transitions the round-23 write-ahead journal
+        records, directly or via ``_job_terminal``/``mark``.  A status
+        flip anywhere else is a lifecycle edge recovery can never
+        replay: the job would be silently lost (or doubled) across a
+        crash-restart.  One finding per assignment — each is its own
+        unjournaled edge."""
+        leaf = qualname.rsplit(".", 1)[-1]
+        if JX021_SANCTIONED_RE.match(leaf):
+            return
+        for node in _walk_shallow(func):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "status":
+                    self._emit(
+                        "JX021", node, qualname,
+                        "fleet job status mutated outside the "
+                        "journal-logging seam — route the transition "
+                        "through _job_terminal/mark (or a sanctioned "
+                        "seam: " + JX021_SANCTIONED_RE.pattern + ") so "
+                        "the write-ahead journal records it and "
+                        "crash recovery can replay it",
+                    )
 
     # -- JX009 -------------------------------------------------------------
 

@@ -289,6 +289,25 @@ RULES: Dict[str, Rule] = {
             "and is path-exempt.",
         ),
         Rule(
+            "JX021",
+            "fleet job status mutated outside the journal-logging seam",
+            "A direct `<job>.status = ...` assignment in cup3d_tpu/"
+            "fleet/ outside the sanctioned seams (FleetBatch.__init__, "
+            "retire, reseed_lane, cancel, _prepare, "
+            "_install_replayed_job) is a lifecycle transition the "
+            "round-23 write-ahead journal never records: the sanctioned "
+            "seams journal their transitions (place/terminal records) "
+            "or funnel into _job_terminal, so FleetServer.recover() can "
+            "replay every accepted job after a crash — terminal jobs "
+            "remembered, queued re-admitted, running resumed from their "
+            "snapshots.  An unjournaled status flip breaks that "
+            "zero-lost-jobs guarantee silently: the job vanishes (or "
+            "doubles) only when a server actually dies.  Route "
+            "transitions through the seams, or extend "
+            "JX021_SANCTIONED_RE when adding a new seam that itself "
+            "journals.",
+        ),
+        Rule(
             "JP001",
             "donated buffer not aliased in the compiled executable",
             "jit(donate_argnums=...) is a PROMISE, not a guarantee: when "

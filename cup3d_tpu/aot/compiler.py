@@ -31,6 +31,7 @@ from typing import Callable, Dict, Optional
 
 from cup3d_tpu.obs import metrics as M
 from cup3d_tpu.obs import trace as OT
+from cup3d_tpu.resilience import faults
 
 PENDING, RUNNING, DONE, FAILED = "pending", "running", "done", "failed"
 
@@ -144,9 +145,14 @@ class CompileService:
                    if t["status"] in (PENDING, RUNNING))
 
     def drain(self, timeout: float = 60.0) -> bool:
-        """Wait until every submitted build finished (tests/CLI)."""
+        """Wait until every submitted build finished (tests/CLI).
+        Death-path (round 23): a dead worker can never finish its
+        orphaned RUNNING task, so each wait iteration reaps orphans —
+        without it, ``_aot_quiesce`` would park for the full timeout on
+        a queue that cannot drain."""
         deadline = OT.now() + float(timeout)
         while True:
+            self.fail_orphans()
             with self._cv:
                 if self.depth_locked() == 0:
                     return True
@@ -154,6 +160,34 @@ class CompileService:
                 if remaining <= 0:
                     return False
                 self._cv.wait(min(remaining, 0.25))
+
+    def fail_orphans(self) -> int:
+        """Death-path recovery (round 23): when the worker thread died
+        (``compile.service_die``, or any uncatchable thread death), its
+        popped-but-unfinished build is stuck RUNNING forever — nothing
+        requeues it, so ``depth()`` never reaches zero and every waiter
+        parks.  Mark such orphans FAILED (the schedulers' existing
+        failed-build path then compiles inline, a transparent
+        degradation counted ``aot.service_fallbacks``) and restart the
+        worker for any still-PENDING queue entries.  Returns the number
+        of orphans failed; 0 while the worker is alive."""
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return 0
+            n = 0
+            for task in self._tasks.values():
+                if task["status"] == RUNNING:
+                    task["status"] = FAILED
+                    task["build"] = None
+                    n += 1
+            if any(t["status"] == PENDING for t in self._tasks.values()):
+                self._ensure_worker()
+            if n:
+                self._cv.notify_all()
+        if n:
+            M.counter("aot.service_fallbacks").inc(n)
+            self._update_depth()
+        return n
 
     def state(self) -> dict:
         """The /health payload."""
@@ -189,6 +223,11 @@ class CompileService:
                 task = self._tasks[key]
                 task["status"] = RUNNING
                 build, name = task["build"], task["name"]
+            # the chaos seam: the worker dies mid-task, leaving this
+            # build orphaned RUNNING — exactly the state fail_orphans()
+            # and the serve() death-path fallback must recover from
+            if faults.fire("compile.service_die"):
+                return
             t0 = OT.now()
             try:
                 result = build()

@@ -48,7 +48,7 @@ from typing import Dict, List, Optional, Tuple
 
 from cup3d_tpu.obs import metrics as M
 from cup3d_tpu.obs import trace as OT
-from cup3d_tpu.resilience import writeguard
+from cup3d_tpu.resilience import faults, writeguard
 
 #: bump on any change to the record layout: old-schema entries become
 #: misses (rejected with reason="schema"), never misreads
@@ -205,6 +205,15 @@ class ExecutableStore:
         if not os.path.exists(path):
             M.counter("aot.store_misses").inc()
             return None
+        if faults.fire("aot.store_corrupt"):
+            # chaos (round 23): garble bytes mid-artifact so this load
+            # exercises the real checksum-reject -> recompile path
+            try:
+                with open(path, "r+b") as f:
+                    f.seek(max(len(MAGIC), os.path.getsize(path) // 2))
+                    f.write(b"\xde\xad\xbe\xef")
+            except OSError:
+                M.counter("aot.store_corrupt_misfires").inc()
         try:
             rec = self._read_record(path)
         except StoreReject as e:

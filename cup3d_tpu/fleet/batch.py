@@ -240,6 +240,18 @@ def reseed_lane_gaits(gaits, lane, gait, mesh=None):
     return _upload_lane_gait(gaits, jnp.asarray(lane, jnp.int32), solo)
 
 
+def lane_carry_host(carry, lane):
+    """One lane's rows of a batched carry as host numpy copies — the
+    serialization half of the round-23 durability contract (the upload
+    half is :func:`reseed_lane_carry`).  ``np.asarray`` round-trips the
+    f32 bits exactly, so journal snapshot -> ``recover()`` reseed -> the
+    SAME compiled advance reproduces the never-crashed trajectory
+    bitwise.  The LEFT budget row is dropped: placement decides the
+    resumed lane's budget (``nsteps`` arg of the reseed upload), exactly
+    as it does for a fresh splice."""
+    return {k: np.asarray(v[lane]) for k, v in carry.items() if k != LEFT}
+
+
 #: lane-track tid stride: lane tids are ``batch_id * LANE_TID_STRIDE +
 #: lane`` so concurrent batches never share a Perfetto thread track
 #: (the pid-3 job-occupancy export, obs/trace.LANE_PID)

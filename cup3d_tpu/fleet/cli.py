@@ -32,15 +32,28 @@ window and which phase's share of end-to-end grew against the rolling
 baseline.  With a job id it prints that one job's exact phase
 decomposition (sums to its e2e by construction) instead — the
 operator's answer to "WHY was this job slow".
+
+Round 23: ``python -m cup3d_tpu fleet recover --workdir DIR`` boots a
+server on an existing workdir, replays its write-ahead journal
+(``FleetServer.recover()``), drains every surviving job, and prints a
+probe-style JSON report: the recovery stats (remembered / requeued /
+resumed), ``recover_restart_s`` (CLI entry -> first dispatch on the
+restarted server, the bench.py durability metric), the RecompileCounter
+advance-compile count (zero with a warm AOT store), and the
+``rows_blake2s`` digest over every job's QoI bytes — the crash drill
+(tools/chaosdrill.py) compares this digest bitwise against an
+unfaulted control run.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 from typing import List, Optional
 
 from cup3d_tpu.fleet.server import FleetServer, summary_json
+from cup3d_tpu.obs import trace as OT
 
 
 def _build_parser(mode: Optional[str]) -> argparse.ArgumentParser:
@@ -122,10 +135,76 @@ def _why_report(server: FleetServer, job_id: Optional[str]) -> dict:
     }
 
 
+def cmd_recover(argv: List[str], t0: float) -> int:
+    """``fleet recover``: journal replay -> drain -> probe report."""
+    from cup3d_tpu.analysis.runtime import RecompileCounter
+
+    ap = argparse.ArgumentParser(
+        prog="python -m cup3d_tpu fleet recover",
+        description="replay a crashed server's write-ahead journal, "
+                    "drain every surviving job, and print the "
+                    "recovery report JSON")
+    ap.add_argument("--workdir", required=True,
+                    help="the crashed server's workdir (holds the "
+                         "journal/ directory)")
+    ap.add_argument("--lanes", type=int, default=None,
+                    help="max lanes per batch (CUP3D_FLEET_LANES)")
+    ap.add_argument("--buckets", type=int, default=None,
+                    help="executable cache cap (CUP3D_FLEET_BUCKETS)")
+    args = ap.parse_args(argv)
+
+    with RecompileCounter() as rc:
+        server = FleetServer(max_lanes=args.lanes,
+                             max_buckets=args.buckets,
+                             workdir=args.workdir)
+        recovery = server.recover()
+        summary = server.drain()
+    dispatched = [t for t in (
+        j.event_time("dispatched") for j in server._jobs.values())
+        if t is not None]
+    digest = hashlib.blake2s()
+    for jid in sorted(server._jobs):
+        digest.update(jid.encode())
+        digest.update(server._jobs[jid].qoi_bytes())
+    from cup3d_tpu.obs import metrics as M
+
+    # count compiles of the fleet advance on either path: live jit
+    # tracing (RecompileCounter) or AOT lower().compile() (the
+    # aot.compile_s histograms) — a warm store serves without either
+    advance_compiles = sum(
+        n for name, n in rc.compiles.items() if "advance" in name)
+    advance_compiles += int(sum(
+        v for k, v in M.snapshot().items()
+        if k.startswith("aot.compile_s{")
+        and "advance" in k and k.endswith(".count")))
+    report = {
+        "recovery": recovery,
+        "recover_restart_s": (min(dispatched) - t0 if dispatched
+                              else None),
+        "total_s": OT.now() - t0,
+        "advance_compiles": advance_compiles,
+        "total_compiles": rc.total_compiles,
+        "rows_blake2s": digest.hexdigest(),
+        "jobs": {jid: server._jobs[jid].status
+                 for jid in sorted(server._jobs)},
+        "durability": server.health()["durability"],
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    bad = sum(st.get("failed", 0) for st in
+              (t["statuses"] for t in summary.values()))
+    return 1 if bad else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import sys
 
+    # the recovery clock starts at CLI entry: recover_restart_s
+    # includes every import + journal replay + driver re-init between
+    # exec and the restarted server's first dispatch
+    t0 = OT.now()
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "recover":
+        return cmd_recover(argv[1:], t0)
     mode = argv[0] if argv and argv[0] in ("slo", "why") else None
     if mode is not None:
         argv = argv[1:]

@@ -203,6 +203,16 @@ class LaneGuard:
         self.snap_step[lane] = 0
         self.snap_left[lane] = int(nsteps)
 
+    def resume(self, carry, lane: int, step: int, left: int) -> None:
+        """A recovered/migrated job was respliced mid-flight (round 23):
+        identical to :meth:`reseed` — epoch bump, fresh retry budget,
+        lane-wise snapshot refresh to the uploaded carry — except the
+        host mirrors record the RESUMED position, not step 0, so a
+        post-resume rollback restores the journaled snapshot state."""
+        self.reseed(carry, lane, left)
+        self.snap_step[lane] = int(step)
+        self.snap_left[lane] = int(left)
+
     def give_up(self, carry, lane: int, reason: str):
         """Retire a lane that exhausted its retries: freeze its carry
         (left = 0) and bump its epoch so stale rows drop."""

@@ -52,6 +52,19 @@ total-lane-steps per drain window) lands in the
 lane tracks.  ``CUP3D_FLEET_CONTINUOUS=0`` keeps the legacy
 generation-drain path bitwise-unchanged.
 
+Round 23 — durability: with ``CUP3D_FLEET_JOURNAL`` on (the default)
+every job lifecycle transition (submit, lane placement, terminal) plus
+a periodic settled K-boundary carry snapshot per lane lands in a
+write-ahead journal (fleet/journal.py) under the server workdir; a
+killed-and-restarted server replays it via :meth:`FleetServer.recover`
+— terminal jobs remembered, queued jobs re-admitted, RUNNING jobs
+resumed from their latest snapshot through the jitted reseed upload
+INTO a batch rebuilt at the RECORDED (cap, K) so the same compiled
+executable reproduces the never-crashed bytes.  fleet/migrate.py rides
+the same checkpoint/resume seams for live migration and graceful
+drains.  ``CUP3D_FLEET_JOURNAL=0`` keeps the serve loop bitwise-legacy
+(no journal instance at all).
+
 Env knobs: ``CUP3D_FLEET_LANES`` caps lanes per batch (default 64),
 ``CUP3D_FLEET_BUCKETS`` caps the executable cache (default 8, LRU),
 ``CUP3D_FLEET_MESH=1`` shards the lane axis over visible devices,
@@ -82,6 +95,7 @@ import numpy as np
 from cup3d_tpu.config import SimulationConfig
 from cup3d_tpu.fleet import batch as FB
 from cup3d_tpu.fleet import isolate as ISO
+from cup3d_tpu.fleet.journal import JobJournal
 from cup3d_tpu.grid.bucket import count_capacity
 from cup3d_tpu.obs import federate as FEDERATE
 from cup3d_tpu.obs import flight as _flight
@@ -104,6 +118,13 @@ RUNNING = "running"
 DONE = "done"
 FAILED = "failed"
 CANCELLED = "cancelled"
+#: round 23 — checkpointed off this server by fleet/migrate.py; the
+#: receiving server finishes the job under the same id
+MIGRATED = "migrated"
+
+#: terminal statuses (the journal replays these verbatim; mirrored as
+#: literals in fleet/journal.py TERMINAL_STATUSES)
+TERMINALS = (DONE, FAILED, CANCELLED, MIGRATED)
 
 #: lane-count ladder base: fleet batches start amortizing at 2 lanes
 LANE_LADDER_BASE = 2
@@ -170,6 +191,10 @@ class FleetJob:
     #: lifecycle seams — never inside the per-step hot loop
     events: List[Tuple[str, float]] = field(default_factory=list)
     _seen: Set[str] = field(default_factory=set, repr=False)
+    #: round 23 — _job_terminal ran for this job (idempotence guard:
+    #: cancel of a mid-migration or journal-replayed job must resolve
+    #: to exactly one terminal, never a double fold into the SLO state)
+    _terminal_done: bool = field(default=False, repr=False)
 
     def mark(self, event: str, once: bool = False,
              collapse: bool = False) -> None:
@@ -413,6 +438,10 @@ class FleetBatch:
                  jobs: List[FleetJob], drivers: list, K: int, cap: int):
         self.server = server
         self.batch_id = batch_id
+        #: cross-restart-unique batch id for journal place/snapshot
+        #: records — a restarted server reuses small batch_ids, and a
+        #: replayed record must never alias a live batch's lanes
+        self.uid = f"{os.getpid():x}.{batch_id}"
         self.kind = kind
         self.K = int(K)
         self.B = int(cap)
@@ -451,6 +480,12 @@ class FleetBatch:
             job.status = RUNNING
             job.mark("running")
             job.rows = np.zeros((job.nsteps, self.row_w), np.float64)
+            # jax-lint: allow(JX013, journal append is host-side file
+            # I/O — no device dispatch per lane; the place record is
+            # inherently per-lane)
+            server._journal(
+                "place", job_id=job.job_id, batch_uid=self.uid,
+                lane=lane, cap=self.B, K=self.K, kind=kind)
         #: lanes whose job has not had its first dispatch marked yet —
         #: steady-state dispatch() pays one empty-set truth test
         self._undispatched: Set[int] = {
@@ -605,7 +640,14 @@ class FleetBatch:
         if self._since_snap >= self.snap_dispatches:
             self.settle()
             self.guard.snapshot(self.carry, self.step_h, self.left_h)
+            self.journal_snapshots()
             self._since_snap = 0
+        # the crash drill's kill switch (round 23): hard process death
+        # at a K-boundary, armed with the dispatch count in the step
+        # slot — recovery may lose at most the work since the last
+        # journaled snapshot, never a job
+        if faults.fire("server.crash", step=int(self.dispatches)):
+            os._exit(23)
 
     def settle(self) -> None:
         """Drain the stream: every emitted row is consumed (and every
@@ -732,6 +774,9 @@ class FleetBatch:
         job.mark("running")
         job.rows = np.zeros((job.nsteps, self.row_w), np.float64)
         self._undispatched.add(lane)
+        self.server._journal(
+            "place", job_id=job.job_id, batch_uid=self.uid,
+            lane=lane, cap=self.B, K=self.K, kind=self.kind)
         M.counter("fleet.reseeds", kind=self.kind).inc()
         M.counter("fleet.lanes", kind=self.kind).inc()
         self.server.update_lane_gauge()
@@ -743,6 +788,84 @@ class FleetBatch:
                 sink.lane_span(
                     FB.lane_track_id(self.batch_id, lane), "idle",
                     t_free, t_run - t_free, args={"job_id": "<idle>"})
+
+    # -- durability (round 23) ---------------------------------------------
+
+    def journal_snapshots(self) -> None:
+        """Journal one carry snapshot per RUNNING lane.  Called at the
+        same settled K-boundary as the rollback snapshot, so the
+        recorded state is always validated: every row up to it consumed
+        clean, ``steps_done == step_h`` per lane.  The recorded (cap,
+        K) let recovery rebuild the SAME compiled executable, which is
+        what makes a resumed trajectory bitwise."""
+        if self.server.journal is None:
+            return
+        for lane in range(self.B):
+            job = self.jobs[lane]
+            if job is None or job.status != RUNNING:
+                continue
+            steps = int(job.steps_done)
+            self.server._journal(
+                "snapshot", job_id=job.job_id, batch_uid=self.uid,
+                cap=self.B, K=self.K, kind=self.kind, lane=lane,
+                step=int(self.step_h[lane]),
+                left=int(self.left_h[lane]),
+                steps_done=steps, time=float(job.time),
+                rows=job.rows[:steps].copy(),
+                carry=FB.lane_carry_host(self.carry, lane))
+
+    def resume_lane(self, lane: int, job: FleetJob, snap: dict) -> None:
+        """Upload one journaled/migrated lane checkpoint into ``lane``:
+        the round-23 resume splice.  The batch was just built with the
+        checkpoint's recorded (cap, K) and ``job`` occupies ``lane``
+        from first assembly (RUNNING, zeroed rows); this re-enters the
+        checkpointed carry through the same jitted per-lane upload as a
+        reseed, restores the recorded rows, and points the guard's host
+        mirrors at the resumed position."""
+        solo = {k: np.asarray(v) for k, v in snap["carry"].items()}
+        step, left = int(snap["step"]), int(snap["left"])
+        self.carry = FB.reseed_lane_carry(
+            self.carry, lane, solo, left, mesh=self.mesh)
+        self.step_h[lane] = step
+        self.left_h[lane] = left
+        self.guard.resume(self.carry, lane, step, left)
+        rows = snap.get("rows")
+        if rows is not None and len(rows):
+            rows = np.asarray(rows, np.float64)
+            job.rows[:rows.shape[0]] = rows
+        job.steps_done = int(snap.get("steps_done", step))
+        job.time = float(snap.get("time", 0.0))
+        M.counter("fleet.lane_resumes", kind=self.kind).inc()
+
+    def release_for_migration(self, lane: int) -> dict:
+        """Checkpoint one RUNNING lane off this batch for live
+        migration (fleet/migrate.py): settle so the lane state is
+        validated, host-serialize the carry + rows, then freeze the
+        lane and retire its job MIGRATED.  Every other lane's bits are
+        untouched (the same lane-wise selects as a cancel).  The
+        returned payload is exactly a journal snapshot view, so the
+        receiving server resumes it through ``resume_lane``."""
+        self.settle()
+        job = self.jobs[lane]
+        if job is None or job.status != RUNNING:
+            raise ValueError(f"lane {lane} holds no RUNNING job")
+        steps = int(job.steps_done)
+        ckpt = {
+            "job_id": job.job_id, "tenant": job.tenant,
+            "spec": dict(job.spec), "nsteps": int(job.nsteps),
+            "kind": self.kind, "cap": self.B, "K": self.K,
+            "step": int(self.step_h[lane]),
+            "left": int(self.left_h[lane]),
+            "steps_done": steps, "time": float(job.time),
+            "rows": job.rows[:steps].copy(),
+            "carry": FB.lane_carry_host(self.carry, lane),
+        }
+        self.carry = ISO.retire_lanes(
+            self.carry, np.arange(self.B) == lane)
+        self.left_h[lane] = 0
+        self.guard.epochs[lane] += 1
+        self.retire(lane, MIGRATED, "migrated")
+        return ckpt
 
     def lane_state(self, lane: int) -> Dict[str, np.ndarray]:
         """Host copies of one lane's carry leaves (tests, summaries)."""
@@ -786,7 +909,13 @@ class FleetServer:
                  max_queue_depth: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
                  provenance: Optional[bool] = None,
+                 journal: Optional[bool] = None,
                  mesh=_MESH_DEFAULT):
+        # the chaos sites (server.crash, journal.write_fail, ...) are
+        # armable from the environment in drill subprocesses
+        # (tools/chaosdrill.py); the solo path loads CUP3D_FAULT at
+        # RecoveryEngine.install, the fleet path loads it here
+        faults.load_env()
         self.max_lanes = int(
             max_lanes if max_lanes is not None
             else _env_int("CUP3D_FLEET_LANES", 64))
@@ -852,6 +981,20 @@ class FleetServer:
         #: per-tenant rolling history of per-job phase SHARES (phase
         #: seconds / e2e), newest last — the burn-attribution baseline
         self._phase_share_history: Dict[str, deque] = {}
+        # round 23 — write-ahead durability.  CUP3D_FLEET_JOURNAL=0
+        # keeps the serve loop bitwise-legacy: no journal instance, no
+        # appends, no recovery — every _journal call is one None test.
+        use_journal = bool(
+            journal if journal is not None
+            else _env_int("CUP3D_FLEET_JOURNAL", 1))
+        self.journal = (
+            JobJournal(os.path.join(self.workdir, "journal"))
+            if use_journal else None)
+        #: admission closed for drain_for_shutdown (fleet/migrate.py)
+        self.draining = False
+        #: the last recover() outcome (health()["durability"])
+        self.last_recovery: Optional[dict] = None
+        self.migrations = 0
         _LIVE.append(weakref.ref(self))
 
     # -- AOT store / background compile (round 21) -------------------------
@@ -1054,6 +1197,10 @@ class FleetServer:
             raise ValueError(f"unknown fleet scenario kind {kind!r}")
         if int(spec.get("nsteps", 0)) <= 0:
             raise ValueError("fleet scenario needs nsteps > 0")
+        if self.draining:
+            M.counter("fleet.admission_rejects", reason="draining").inc()
+            raise FleetAdmissionError(
+                "draining", "server is draining for shutdown")
         depth = self.queue_depth()
         if depth >= self.max_queue_depth:
             M.counter("fleet.admission_rejects", reason="queue-full").inc()
@@ -1079,6 +1226,8 @@ class FleetServer:
         job.mark("submitted")
         job.mark("queued")
         self._jobs[job_id] = job
+        self._journal("submit", job_id=job_id, tenant=job.tenant,
+                      spec=dict(spec), nsteps=job.nsteps)
         M.counter("fleet.submits").inc()
         return job_id
 
@@ -1159,6 +1308,12 @@ class FleetServer:
                 j.status == QUEUED for j in self._jobs.values())
             if (not live and queued and self._aot_service is not None
                     and self._aot_service.depth() > 0):
+                # death-path (round 23): a dead compile worker can
+                # never finish its orphaned builds — reap them FAILED
+                # (aot.service_fallbacks) so the next scheduling pass
+                # compiles inline, instead of parking forever below
+                if self._aot_service.fail_orphans():
+                    continue
                 # every queued job waits on a background compile and
                 # nothing is dispatchable: park on the service instead
                 # of busy-spinning the scheduler
@@ -1183,6 +1338,135 @@ class FleetServer:
 
     def queue_depth(self) -> int:
         return sum(1 for j in self._jobs.values() if j.status == QUEUED)
+
+    # -- durability (round 23) ---------------------------------------------
+
+    def _journal(self, rtype: str, **fields) -> None:
+        """Best-effort journal append (no-op with the journal off)."""
+        if self.journal is not None:
+            self.journal.append(rtype, **fields)
+
+    def close_admission(self) -> None:
+        """Stop accepting new jobs (drain-for-shutdown seam,
+        fleet/migrate.py): submit() rejects with reason "draining"."""
+        self.draining = True
+
+    def _note_job_id(self, job_id: str) -> None:
+        """Keep the job-id counter ahead of a replayed id so a
+        recovered server never mints a colliding fresh id."""
+        try:
+            n = int(job_id.rsplit("-", 1)[-1])
+        # jax-lint: allow(JX009, foreign-format replayed ids cannot
+        # collide with the server's job-%04d mint, so there is nothing
+        # to advance past; journal.orphan_records covers the taxonomy)
+        except ValueError:
+            return
+        self._next_job = max(self._next_job, n + 1)
+
+    def recover(self) -> dict:
+        """Replay the write-ahead journal into this server (boot-time;
+        idempotent — job ids already known are skipped, so replaying
+        twice, or a journal extended by this server's own appends, is a
+        no-op).  Terminal jobs are remembered with their recorded rows
+        (QoI bytes intact, nothing re-runs); queued jobs re-enter the
+        queue; RUNNING jobs with a snapshot resume mid-flight in a
+        batch rebuilt at the recorded (cap, K) — same executable, same
+        bytes; RUNNING jobs that never reached a snapshot restart from
+        step 0, which recomputes the identical trajectory.  Returns
+        ``{replayed, remembered, requeued, resumed}``."""
+        stats = {"replayed": 0, "remembered": 0, "requeued": 0,
+                 "resumed": 0}
+        if self.journal is None:
+            self.last_recovery = dict(stats)
+            return self.last_recovery
+        pending: List[Tuple[FleetJob, dict]] = []
+        for job_id, view in self.journal.replay().items():
+            if job_id in self._jobs:
+                continue
+            stats["replayed"] += 1
+            job = FleetJob(
+                job_id=job_id, tenant=str(view["tenant"]),
+                spec=dict(view["spec"]), nsteps=int(view["nsteps"]))
+            self._note_job_id(job_id)
+            self._jobs[job_id] = job
+            snap = self._install_replayed_job(job, view)
+            if job.status in TERMINALS:
+                stats["remembered"] += 1
+            elif snap is not None:
+                pending.append((job, snap))
+                stats["resumed"] += 1
+            else:
+                stats["requeued"] += 1
+        if pending:
+            self._resume_batches(pending)
+        self.update_lane_gauge()
+        self.last_recovery = dict(stats)
+        return self.last_recovery
+
+    def _install_replayed_job(self, job: FleetJob,
+                              view: dict) -> Optional[dict]:
+        """Install one replayed journal view onto a fresh FleetJob.
+        Returns the snapshot record to resume from (RUNNING jobs with a
+        journaled snapshot), else None.  Terminal replays keep their
+        recorded rows/steps and set the ``_terminal_done`` guard — the
+        crashed server already folded them into its SLO bookkeeping, so
+        this server only REMEMBERS them (poll/summaries/QoI bytes),
+        it does not re-observe them."""
+        status = view["status"]
+        if status in TERMINALS:
+            job.status = status
+            job.error = view.get("error")
+            job.steps_done = int(view.get("steps_done", 0))
+            job.time = float(view.get("time", 0.0))
+            rows = view.get("rows")
+            if rows is not None:
+                job.rows = np.asarray(rows, np.float64).copy()
+            job.mark(status)
+            job._terminal_done = True
+            M.counter("fleet.recovered_jobs", outcome="remembered").inc()
+            return None
+        job.status = QUEUED
+        job.mark("submitted")
+        job.mark("queued")
+        job.mark("recovered")
+        snap = view.get("snapshot") if status == RUNNING else None
+        M.counter("fleet.recovered_jobs",
+                  outcome="resumed" if snap is not None
+                  else "requeued").inc()
+        return snap
+
+    def _resume_batches(self, pending) -> int:
+        """Rebuild one batch per crashed batch_uid at its RECORDED
+        (cap, K) and splice every resumed job back in at its journaled
+        position.  Forcing the recorded shape — rather than re-deriving
+        the rung from the (smaller) survivor count — is what keeps
+        recovery bitwise: the lane count enters the compiled
+        executable, and only the crashed server's own executable
+        reproduces the control bytes (with a warm AOT store it loads
+        from disk, zero recompiles)."""
+        groups: "OrderedDict[object, list]" = OrderedDict()
+        for job, snap in pending:
+            prep = self._prepare(job)
+            if prep is None:
+                continue
+            kind, drv, _sig, _key = prep
+            groups.setdefault(snap.get("batch_uid"), []).append(
+                (kind, job, drv, snap))
+        resumed = 0
+        for members in groups.values():
+            kind = members[0][0]
+            snap0 = members[0][3]
+            cap, K = int(snap0["cap"]), int(snap0["K"])
+            jobs = [job for _, job, _, _ in members]
+            drivers = [drv for _, _, drv, _ in members]
+            b = FleetBatch(self, self._next_batch, kind, jobs,
+                           drivers, K, cap)
+            self._next_batch += 1
+            self.batches.append(b)
+            for lane, (_, job, _, snap) in enumerate(members):
+                b.resume_lane(lane, job, snap)
+                resumed += 1
+        return resumed
 
     # -- assembly ----------------------------------------------------------
 
@@ -1403,7 +1687,19 @@ class FleetServer:
         the SLO histograms + breach window, notify the flight recorders,
         and (tracing on) emit the kind="job" aux record and the pid-3
         lane-occupancy span.  Called exactly once per job — every
-        terminal transition funnels through here."""
+        terminal transition funnels through here, and the
+        ``_terminal_done`` guard (round 23) makes a second arrival — a
+        cancel racing a migration, or a replayed-from-journal terminal
+        — a counted no-op instead of a double SLO fold."""
+        if job._terminal_done:
+            M.counter("fleet.duplicate_terminals").inc()
+            return
+        job._terminal_done = True
+        self._journal(
+            "terminal", job_id=job.job_id, status=job.status,
+            error=job.error, steps_done=int(job.steps_done),
+            time=float(job.time), nsteps=int(job.nsteps),
+            rows=None if job.rows is None else job.rows.copy())
         durs = job.durations()
         bucket = job.sig_label or "unbucketed"
         if "queue_wait_s" in durs:
@@ -1681,6 +1977,13 @@ class FleetServer:
                     for lane in b.dead_lanes),
                 "shard_losses": int(
                     M.counter("fleet.shard_losses").value),
+            },
+            "durability": {
+                "journal": (None if self.journal is None
+                            else self.journal.state()),
+                "draining": bool(self.draining),
+                "recovered": self.last_recovery,
+                "migrations": int(self.migrations),
             },
             "knobs": {
                 "max_lanes": self.max_lanes,

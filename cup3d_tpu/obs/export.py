@@ -240,7 +240,7 @@ def health_payload() -> dict:
         })
     counters = {k: v for k, v in snap.items()
                 if k.startswith(("flight.", "resilience.", "recovery.",
-                                 "fleet.", "aot."))}
+                                 "fleet.", "aot.", "journal."))}
     # live fleet servers (weakref registry, same pattern as the flight
     # recorders); the lazy import keeps obs importable standalone
     from cup3d_tpu.fleet.server import live_servers as _fleet_live

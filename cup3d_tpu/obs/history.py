@@ -146,6 +146,19 @@ DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
          ("detail", "fleet_compile_wait_frac")),
         higher_is_better=False,
     ),
+    # round 23 (durable fleet): crashed-server restart latency of the
+    # bench.py durability drill — ``fleet recover`` CLI entry to the
+    # restarted server's first dispatch (journal replay + driver
+    # re-init + lane resume, subprocess-measured against a warm
+    # executable store).  A rise means recovery started recompiling or
+    # replaying slowly — the restart path stopped being cheap;
+    # lower is better
+    MetricSpec(
+        "recover_restart_s",
+        (("durability", "recover_restart_s"),
+         ("detail", "recover_restart_s")),
+        higher_is_better=False,
+    ),
 )
 
 

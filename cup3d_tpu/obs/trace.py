@@ -130,10 +130,15 @@ JOB_REQUIRED = {"schema": int, "step": int, "job_id": str, "tenant": str,
 #: parked on a background CompileService build (round 21 AOT path);
 #: "reseed_wait" marks a job blocked on a live compatible batch with no
 #: free lane (it waits for a K-boundary reseed instead of capacity).
-JOB_EVENTS = ("submitted", "queued", "bucketed", "compile_wait",
-              "compile_ready", "reseed_wait", "reseeded", "running",
-              "dispatched", "fanout", "rollback", "retire",
-              "done", "failed", "cancelled")
+#: "recovered" marks a job replayed from the write-ahead journal on a
+#: restarted server (round 23) — it opens the interval the job spends
+#: waiting for its resume placement; "migrated" is the terminal of a
+#: job checkpointed off this server by fleet/migrate.py (the receiving
+#: server runs it under the same job id with a fresh timeline).
+JOB_EVENTS = ("submitted", "queued", "recovered", "bucketed",
+              "compile_wait", "compile_ready", "reseed_wait", "reseeded",
+              "running", "dispatched", "fanout", "rollback", "retire",
+              "done", "failed", "cancelled", "migrated")
 
 #: the exclusive latency-provenance phases (round 22).  Every interval
 #: between consecutive job events is attributed to exactly one phase —
@@ -164,6 +169,10 @@ PHASE_OF_EVENT = {
     "done": "retire",
     "failed": "retire",
     "cancelled": "retire",
+    # round 23: a journal-replayed job waits for capacity on the
+    # restarted server; a migrated-away job's timeline ends here
+    "recovered": "capacity_wait",
+    "migrated": "retire",
 }
 
 

@@ -59,6 +59,22 @@ SITES = (
     # the next dispatch boundary (resilience/elastic.fail_shard via
     # fleet/server.FleetBatch.dispatch)
     "fleet.shard_loss",
+    # round 23 — durability chaos sites:
+    # journal segment write raises inside the writeguard seam (one-shot
+    # arms are absorbed by the retry; wildcard arms exhaust it and the
+    # append is counted-dropped, never raised to the serve loop)
+    "journal.write_fail",
+    # hard process death (os._exit) at a dispatch K-boundary of
+    # fleet/server.FleetBatch.dispatch, armed with the DISPATCH count in
+    # the step slot — the crash-restart drill's kill switch
+    "server.crash",
+    # flips bytes mid-artifact before an aot/store.py load, driving the
+    # read down the checksum-reject path (transparent recompile)
+    "aot.store_corrupt",
+    # kills the background compile worker thread mid-task
+    # (aot/compiler.py _run), leaving its build orphaned RUNNING — the
+    # death-path serve() must fall back from, not park on
+    "compile.service_die",
 )
 
 ENV_VAR = "CUP3D_FAULT"

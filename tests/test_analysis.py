@@ -1025,6 +1025,80 @@ def test_jx020_package_is_clean():
     assert out.returncode == 0, out.stdout + out.stderr
 
 
+def test_jx021_status_mutation_fires_suppresses_and_scopes():
+    """Fleet job status mutated outside the journal-logging seam
+    (round 23): a transition the write-ahead journal never records is
+    a job a crash-restart can silently lose or double."""
+    FLEET = "cup3d_tpu/fleet/fixture.py"
+    src = (
+        "class S:\n"
+        "    def poke(self, job):\n"
+        "        job.status = 'done'\n"
+    )
+    vs = _failing(src, FLEET)
+    assert _rules(vs) == {"JX021"} and len(vs) == 1
+    assert "_job_terminal" in vs[0].message
+    # every sanctioned seam stays clean — those are the functions whose
+    # transitions the journal records (directly or via _job_terminal)
+    for seam in ("__init__", "retire", "reseed_lane", "cancel",
+                 "_prepare", "_install_replayed_job"):
+        clean = (
+            "class S:\n"
+            f"    def {seam}(self, job):\n"
+            "        job.status = 'running'\n"
+        )
+        assert not _failing(clean, FLEET), seam
+    # one finding PER assignment: each is its own unjournaled edge
+    two = (
+        "def swap(a, b):\n"
+        "    a.status = 'done'\n"
+        "    b.status = 'failed'\n"
+    )
+    assert len([v for v in _failing(two, FLEET)
+                if v.rule == "JX021"]) == 2
+    # annotated and augmented assignment forms resolve too
+    ann = (
+        "def poke(job):\n"
+        "    job.status: str = 'done'\n"
+    )
+    assert _rules(_failing(ann, FLEET)) == {"JX021"}
+    # module-level mutations fire
+    toplevel = "JOB.status = 'done'\n"
+    assert "JX021" in _rules(_failing(toplevel, FLEET))
+    # a plain local named status is not a job transition
+    local = (
+        "def poke(job):\n"
+        "    status = 'done'\n"
+        "    return status\n"
+    )
+    assert not _failing(local, FLEET)
+    # the rule is scoped to fleet/ — sim code has no fleet jobs
+    assert not _failing(src, HOT)
+    # annotation suppresses with the reason recorded
+    ok = src.replace(
+        "        job.status = 'done'",
+        "        # jax-lint: allow(JX021, test fixture freezes state)\n"
+        "        job.status = 'done'",
+    )
+    all_vs = L.lint_source(ok, FLEET)
+    assert not [v for v in L.failing(all_vs) if v.rule == "JX021"]
+    assert any(
+        v.rule == "JX021" and v.suppressed and
+        v.suppression_reason == "test fixture freezes state"
+        for v in all_vs)
+
+
+def test_jx021_package_is_clean():
+    """EMPTY baseline: every fleet status transition routes through a
+    sanctioned journal-logging seam."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cup3d_tpu.analysis", "--rules", "JX021",
+         "--no-baseline", "cup3d_tpu/", "-q"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
 def test_jx014_wallclock_duration_fires_and_suppresses():
     """Wall-clock subtraction used as a duration (round 16): NTP slews
     and steps time.time(), so a latency computed from it can go

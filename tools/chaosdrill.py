@@ -1,0 +1,379 @@
+#!/usr/bin/env python
+"""Chaos drill: kill, restart and migrate a fleet under injected
+faults and prove nothing was lost (round 23).
+
+The full drill (default mode) runs SUBPROCESSES against one shared
+workdir + AOT executable store, on a seeded schedule:
+
+1. control  — an unfaulted serve (journal OFF: the bitwise-legacy
+   baseline) prints its QoI digest,
+2. crash    — the same spec with ``CUP3D_FAULT=server.crash@N`` armed
+   (N drawn from the ``--seed`` PRNG): the server dies ``os._exit(23)``
+   at a K-boundary dispatch, mid-serve,
+3. restarts — ``--kills`` total process deaths: each intermediate
+   ``python -m cup3d_tpu fleet recover`` run is itself crash-armed,
+   the final one runs unfaulted to completion,
+4. verdict  — the final recovery report must show every control job
+   terminal DONE (zero lost jobs), ``rows_blake2s`` equal to the
+   control digest (bitwise QoI), and ZERO advance compiles
+   (RecompileCounter + aot.compile_s — the store stayed warm across
+   every death), plus an in-process live-migration leg with the same
+   bitwise bar.
+
+``--selftest`` is the CI mode (tools/lint.sh): the same guarantees
+exercised in-process on CPU in seconds — journal defect-taxonomy skips
+(one corrupt segment per reject class, replay keeps every healthy
+record), a crash-abandon-recover drill bitwise against an unfaulted
+control, replay idempotence (a second ``recover()`` is a no-op), a
+one-shot ``journal.write_fail`` absorbed by the writeguard retry, and
+a ``migrate_job`` handoff bitwise against the same control.
+
+Usage::
+
+    python tools/chaosdrill.py --selftest          # CI drill (CPU)
+    python tools/chaosdrill.py                     # subprocess drill
+    python tools/chaosdrill.py --seed 7 --kills 3  # seeded schedule
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _specs(njobs: int, n: int, nsteps: int) -> list:
+    return [dict(kind="tgv", n=n, nsteps=nsteps, cfl=0.3,
+                 tenant=f"drill-{i}") for i in range(njobs)]
+
+
+def _digest_map(qoi: dict) -> str:
+    """blake2s over sorted (job_id, qoi_bytes) — the exact digest the
+    ``fleet recover`` CLI report prints as ``rows_blake2s``."""
+    digest = hashlib.blake2s()
+    for jid in sorted(qoi):
+        digest.update(jid.encode())
+        digest.update(qoi[jid])
+    return digest.hexdigest()
+
+
+def _digest_server(server) -> str:
+    return _digest_map(
+        {jid: j.qoi_bytes() for jid, j in server._jobs.items()})
+
+
+# -- in-process selftest legs (CI: tools/lint.sh) --------------------------
+
+
+def _selftest_defects() -> None:
+    """One corrupt segment per defect class: replay counts the reject
+    and keeps every healthy record."""
+    from cup3d_tpu.fleet.journal import MAGIC, JobJournal
+    from cup3d_tpu.obs import metrics as M
+
+    root = tempfile.mkdtemp(prefix="cup3d-chaos-journal-")
+    j = JobJournal(root)
+    paths = [j.append("submit", job_id=f"job-{i:04d}", tenant="t",
+                      spec={"kind": "tgv"}, nsteps=8) for i in range(6)]
+    assert all(paths), "healthy appends must succeed"
+
+    with open(paths[1], "r+b") as f:          # magic
+        f.write(b"XXXX")
+    with open(paths[2], "r+b") as f:          # truncated
+        f.truncate(len(MAGIC) + 4)
+    blob = open(paths[3], "rb").read()        # checksum
+    with open(paths[3], "wb") as f:
+        f.write(blob[:-1] + bytes([blob[-1] ^ 0xFF]))
+    inner = b"\x80\x04 this is not a pickle"  # unpickle
+    with open(paths[4], "wb") as f:
+        f.write(MAGIC + hashlib.blake2s(inner).hexdigest().encode()
+                + b"\n" + inner)
+    import pickle
+    inner = pickle.dumps({"schema": 999, "type": "submit", "seq": 5})
+    with open(paths[5], "wb") as f:           # schema
+        f.write(MAGIC + hashlib.blake2s(inner).hexdigest().encode()
+                + b"\n" + inner)
+    os.makedirs(j.path_for(99))               # io (a dir, not a file)
+
+    s0 = M.snapshot()
+    view = JobJournal(root).replay()
+    d = M.delta(s0)
+    assert set(view) == {"job-0000"}, sorted(view)
+    for reason in ("magic", "truncated", "checksum", "unpickle",
+                   "schema", "io"):
+        got = d.get(f"journal.rejects{{reason={reason}}}", 0)
+        assert got == 1, (reason, got)
+    print("chaosdrill: defect taxonomy OK "
+          "(6 reject classes counted + skipped, healthy record kept)")
+
+
+def _selftest_write_fail() -> None:
+    """A one-shot journal.write_fail is absorbed by the writeguard
+    retry: the append still lands, counted as a retry."""
+    from cup3d_tpu.fleet.journal import JobJournal
+    from cup3d_tpu.obs import metrics as M
+    from cup3d_tpu.resilience import faults
+
+    j = JobJournal(tempfile.mkdtemp(prefix="cup3d-chaos-wfail-"))
+    faults.clear()
+    faults.arm("journal.write_fail", "*", 1)
+    s0 = M.snapshot()
+    path = j.append("submit", job_id="job-0000", tenant="t",
+                    spec={}, nsteps=1)
+    d = M.delta(s0)
+    faults.clear()
+    assert path is not None and os.path.exists(path)
+    assert d.get("resilience.write_retries{site=fleet-journal}", 0) >= 1
+    assert d.get("journal.append_failures{type=submit}", 0) == 0
+    rec = JobJournal(j.root).records()
+    assert len(rec) == 1 and rec[0]["job_id"] == "job-0000"
+    print("chaosdrill: write-fail retry OK "
+          "(1-shot fault absorbed, segment promoted)")
+
+
+def _control(root: str, specs: list):
+    """The unfaulted journal-OFF baseline every leg compares against."""
+    from cup3d_tpu.fleet.server import FleetServer
+
+    ctl = FleetServer(max_lanes=4, snap_every=8,
+                      workdir=os.path.join(root, "ctl"), journal=False)
+    ids = [ctl.submit(sc["tenant"], sc) for sc in specs]
+    ctl.drain()
+    return ctl, ids, _digest_server(ctl)
+
+
+def _selftest_recover(root: str, specs: list, ids: list,
+                      ctl_digest: str) -> None:
+    """Crash-abandon-recover, bitwise, idempotent."""
+    from cup3d_tpu.fleet.server import DONE, FleetServer
+
+    wd = os.path.join(root, "crash")
+    crashy = FleetServer(max_lanes=4, snap_every=8, workdir=wd,
+                         journal=True)
+    got = [crashy.submit(sc["tenant"], sc) for sc in specs]
+    assert got == ids, (got, ids)
+    crashy._schedule()
+    for _ in range(2):  # two K-boundaries: snapshots land, jobs do not
+        for b in crashy.batches:
+            b.tick()
+    for b in crashy.batches:
+        b.settle()
+    # abandon mid-flight: no terminal records exist for either job
+    assert all(crashy._jobs[j].status == "running" for j in ids)
+
+    fresh = FleetServer(max_lanes=4, snap_every=8, workdir=wd,
+                        journal=True)
+    rec = fresh.recover()
+    assert rec["resumed"] == len(ids), rec
+    fresh.drain()
+    assert all(fresh._jobs[j].status == DONE for j in ids)
+    assert _digest_server(fresh) == ctl_digest, "recovery not bitwise"
+    again = fresh.recover()
+    assert (again["remembered"], again["requeued"],
+            again["resumed"]) == (0, 0, 0), again
+    print("chaosdrill: crash-recover OK "
+          f"(resumed={rec['resumed']}, bitwise vs control, "
+          "second replay a no-op)")
+
+
+def _selftest_migrate(root: str, specs: list, ids: list,
+                      ctl_digest: str) -> None:
+    """Live handoff of a RUNNING lane, bitwise."""
+    from cup3d_tpu.fleet.migrate import migrate_job
+    from cup3d_tpu.fleet.server import DONE, MIGRATED, FleetServer
+
+    s1 = FleetServer(max_lanes=4, snap_every=8,
+                     workdir=os.path.join(root, "mig-src"), journal=True)
+    got = [s1.submit(sc["tenant"], sc) for sc in specs]
+    assert got == ids
+    s1._schedule()
+    for b in s1.batches:
+        b.tick()
+        b.settle()
+    s2 = FleetServer(max_lanes=4, snap_every=8,
+                     workdir=os.path.join(root, "mig-dst"), journal=True)
+    moved = migrate_job(s1, s2, ids[0])
+    assert moved == ids[0]
+    assert s1.poll(ids[0])["status"] == MIGRATED
+    s2.drain()
+    s1.drain()
+    assert s2._jobs[ids[0]].status == DONE
+    assert s1._jobs[ids[1]].status == DONE
+    digest = _digest_map({ids[0]: s2._jobs[ids[0]].qoi_bytes(),
+                          ids[1]: s1._jobs[ids[1]].qoi_bytes()})
+    assert digest == ctl_digest, "migration not bitwise"
+    print("chaosdrill: migrate OK "
+          "(source MIGRATED, destination finished bitwise)")
+
+
+def selftest() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _selftest_defects()
+    _selftest_write_fail()
+    root = tempfile.mkdtemp(prefix="cup3d-chaos-self-")
+    specs = _specs(njobs=2, n=16, nsteps=24)
+    _ctl, ids, ctl_digest = _control(root, specs)
+    _selftest_recover(root, specs, ids, ctl_digest)
+    _selftest_migrate(root, specs, ids, ctl_digest)
+    print("chaosdrill: selftest OK")
+    return 0
+
+
+# -- subprocess drill (the real thing) -------------------------------------
+
+
+def _run(cmd, env, ok_codes=(0,), timeout=1200):
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         env=env, timeout=timeout)
+    if out.returncode not in ok_codes:
+        raise RuntimeError(
+            f"{' '.join(cmd[-6:])} rc={out.returncode} "
+            f"(wanted {ok_codes}): " + (out.stderr or out.stdout)[-400:])
+    return out
+
+
+def cmd_serve(args) -> int:
+    """Hidden drill worker: serve one spec file to completion (or die
+    trying — the crash arm is in CUP3D_FAULT) and print the digest."""
+    from cup3d_tpu.fleet.server import FleetServer
+
+    with open(args.spec) as f:
+        specs = json.load(f)
+    server = FleetServer(max_lanes=args.lanes, snap_every=args.snap_every,
+                         workdir=args.workdir,
+                         journal=bool(args.journal))
+    for sc in specs:
+        server.submit(sc.get("tenant", "t"), sc)
+    server.drain()
+    print(json.dumps({
+        "rows_blake2s": _digest_server(server),
+        "jobs": {jid: j.status for jid, j in server._jobs.items()},
+    }, indent=2, sort_keys=True))
+    return 1 if any(j.status != "done"
+                    for j in server._jobs.values()) else 0
+
+
+def full_drill(args) -> int:
+    rng = random.Random(args.seed)
+    root = tempfile.mkdtemp(prefix="cup3d-chaos-")
+    spec_path = os.path.join(root, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(_specs(args.jobs, args.n, args.nsteps), f)
+
+    base = dict(os.environ, CUP3D_AOT_STORE=os.path.join(root, "store"),
+                CUP3D_SNAP_EVERY="8")
+    base.setdefault("JAX_PLATFORMS", "cpu")
+    base.pop("CUP3D_FAULT", None)
+    me = os.path.abspath(__file__)
+
+    def serve(tag, journal, fault=None, ok=(0,)):
+        env = dict(base)
+        if fault:
+            env["CUP3D_FAULT"] = fault
+        return _run([sys.executable, me, "_serve",
+                     "--workdir", os.path.join(root, tag),
+                     "--spec", spec_path, "--lanes", "4",
+                     "--snap-every", "8",
+                     "--journal", "1" if journal else "0"],
+                    env, ok_codes=ok)
+
+    def recover(fault=None, ok=(0,)):
+        env = dict(base)
+        if fault:
+            env["CUP3D_FAULT"] = fault
+        return _run([sys.executable, "-m", "cup3d_tpu", "fleet",
+                     "recover", "--workdir", os.path.join(root, "crash"),
+                     "--lanes", "4"], env, ok_codes=ok)
+
+    print(f"chaosdrill: seed={args.seed} kills={args.kills} "
+          f"jobs={args.jobs} nsteps={args.nsteps} n={args.n} ({root})")
+    ctl = json.loads(serve("ctl", journal=False).stdout)
+    print(f"chaosdrill: control digest {ctl['rows_blake2s'][:16]}…")
+
+    # first death mid-serve: armed at a seeded K-boundary dispatch
+    kill_at = rng.randint(1, 2)
+    serve("crash", journal=True,
+          fault=f"server.crash@{kill_at}", ok=(23,))
+    print(f"chaosdrill: server killed at dispatch {kill_at} (rc 23)")
+
+    # intermediate restarts are themselves crash-armed (a recovery
+    # that dies recovers); a short run may finish before the arm
+    # matches, so rc 0 is acceptable there — the final recover is the
+    # one that must come up clean
+    for k in range(max(0, args.kills - 1)):
+        step = rng.randint(1, 2)
+        out = recover(fault=f"server.crash@{step}", ok=(0, 23))
+        print(f"chaosdrill: restart {k + 1} armed at dispatch {step} "
+              f"-> rc {out.returncode}")
+    report = json.loads(recover().stdout)
+
+    lost = sorted(set(ctl["jobs"]) - set(report["jobs"]))
+    not_done = sorted(j for j, st in report["jobs"].items()
+                      if st != "done")
+    bitwise = report["rows_blake2s"] == ctl["rows_blake2s"]
+    recompiles = int(report["advance_compiles"])
+    verdict = {
+        "seed": args.seed,
+        "kills": args.kills,
+        "lost_jobs": lost,
+        "not_done": not_done,
+        "bitwise_equal": bitwise,
+        "advance_compiles": recompiles,
+        "recover_restart_s": report["recover_restart_s"],
+        "recovery": report["recovery"],
+    }
+    print(json.dumps(verdict, indent=2, sort_keys=True))
+    ok = not lost and not not_done and bitwise and recompiles == 0
+    if ok:
+        # the migration leg rides the same contract in-process
+        os.environ.setdefault("JAX_PLATFORMS",
+                              base.get("JAX_PLATFORMS", "cpu"))
+        specs = _specs(args.jobs, args.n, args.nsteps)
+        _ctl, ids, ctl_digest = _control(os.path.join(root, "mig"), specs)
+        _selftest_migrate(os.path.join(root, "mig"), specs, ids,
+                          ctl_digest)
+        print("chaosdrill: drill OK (zero lost jobs, bitwise QoI, "
+              "zero steady-state recompiles)")
+        return 0
+    print("chaosdrill: DRILL FAILED")
+    return 1
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "_serve":
+        ap = argparse.ArgumentParser(prog="chaosdrill _serve")
+        ap.add_argument("--workdir", required=True)
+        ap.add_argument("--spec", required=True)
+        ap.add_argument("--lanes", type=int, default=4)
+        ap.add_argument("--snap-every", type=int, default=8)
+        ap.add_argument("--journal", type=int, default=1)
+        return cmd_serve(ap.parse_args(argv[1:]))
+    ap = argparse.ArgumentParser(
+        description="fleet chaos drill: kill/restart/migrate under "
+                    "injected faults, assert zero lost jobs + bitwise "
+                    "QoI vs an unfaulted control")
+    ap.add_argument("--selftest", action="store_true",
+                    help="fast in-process CI drill (tools/lint.sh)")
+    ap.add_argument("--seed", type=int, default=23,
+                    help="PRNG seed for the kill schedule")
+    ap.add_argument("--kills", type=int, default=2,
+                    help="total process deaths before the clean restart")
+    ap.add_argument("--jobs", type=int, default=2)
+    ap.add_argument("--nsteps", type=int, default=24)
+    ap.add_argument("--n", type=int, default=16)
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    return full_drill(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,12 +1,12 @@
 #!/bin/sh
-# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX020
+# CI lint gate: JAX-hazard lint (cup3d_tpu/analysis/, rules JX001-JX021
 # incl. the JX007 jit-in-regrid-loop, JX008 timing-outside-obs, JX009
 # swallowed-exception, JX011 bf16-reduction-accumulator, JX012
 # profiler-outside-obs, JX013 per-lane-loop, JX014
 # wall-clock-duration, JX015 per-tick-batch-reassembly, JX016
 # sharded-materialization, JX017 hand-typed-hardware-peak, JX018
-# raw-collective-outside-parallel/, JX019 aot-seam and JX020
-# raw-clock-outside-trace rules)
+# raw-collective-outside-parallel/, JX019 aot-seam, JX020
+# raw-clock-outside-trace and JX021 status-outside-journal-seam rules)
 # + the IR audit (rules JP001-JP005: traced jaxprs + AOT alias maps of
 #   the canonical entry points, `python -m cup3d_tpu.analysis audit`)
 # + the fused-BiCGSTAB interpret-mode kernel smoke
@@ -114,6 +114,14 @@ python -m cup3d_tpu.analysis --rules JX019 cup3d_tpu/ -q
 echo "== python -m cup3d_tpu.analysis --rules JX020 cup3d_tpu/"
 python -m cup3d_tpu.analysis --rules JX020 cup3d_tpu/ -q
 
+# the journal-seam rule on its own line (round 23): a fleet job status
+# mutation outside the journal-logging seams (__init__ / retire /
+# reseed_lane / cancel / _prepare / _install_replayed_job) fails CI
+# identifiably — every transition must hit the write-ahead journal or
+# a crash loses the job, breaking the zero-lost-jobs recovery contract
+echo "== python -m cup3d_tpu.analysis --rules JX021 cup3d_tpu/fleet"
+python -m cup3d_tpu.analysis --rules JX021 cup3d_tpu/fleet -q
+
 # the IR audit (round 20): trace + AOT-lower the canonical entry points
 # (uniform/fish/AMR megaloops, fleet advance+reseed, mesh-sharded
 # megaloop, fused BiCGSTAB stages) and check donation aliasing (JP001),
@@ -151,6 +159,12 @@ JAX_PLATFORMS=cpu python -c \
 # fires, malformed store lines skipped
 echo "== python tools/perfwatch.py --selftest"
 python tools/perfwatch.py --selftest
+
+# durability drill selftest (round 23): journal defect-class skips,
+# abandon-and-recover bitwise vs an unfaulted control, live migration
+# bitwise — all in-process on CPU, no subprocess kills
+echo "== python tools/chaosdrill.py --selftest"
+JAX_PLATFORMS=cpu python tools/chaosdrill.py --selftest
 
 echo "== python -m compileall"
 python -m compileall -q cup3d_tpu/ tests/ tools/ bench.py

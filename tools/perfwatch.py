@@ -7,7 +7,7 @@ Reads the append-only JSONL store ``bench.py`` writes after every run
 ``wall_per_step_p95_s``, ``fleet_cells_per_s``, ``amr_cells_per_s``,
 ``amr_bicgstab_iter_device_ms``, ``fleet_job_p99_s``,
 ``fleet_occupancy``, ``fleet_compile_wait_frac``,
-``mesh_cells_per_s``), compares the newest value
+``mesh_cells_per_s``, ``recover_restart_s``), compares the newest value
 against the
 median of the previous N — the BENCH_r0x snapshots as a
 machine-checkable time series.
@@ -105,7 +105,11 @@ def selftest() -> None:
                 "mesh2d": {"mesh_cells_per_s": 4.0e6 * amr_scale},
                 # round 21: warm-store boot-to-first-dispatch of the
                 # cold_start config — RISES when boot starts recompiling
-                "cold_start": {"warm_start_s": 1.5 / amr_scale}}
+                "cold_start": {"warm_start_s": 1.5 / amr_scale},
+                # round 23: crashed-server restart latency of the
+                # durability drill (journal replay + lane resume) —
+                # RISES when the recovery path starts recompiling
+                "durability": {"recover_restart_s": 2.0 / amr_scale}}
 
     with tempfile.TemporaryDirectory() as td:
         store = obs_history.HistoryStore(os.path.join(td, "hist.jsonl"))
@@ -131,7 +135,7 @@ def selftest() -> None:
                      "fleet_job_p99_s", "fleet_occupancy",
                      "fleet_compile_wait_frac",
                      "mesh_cells_per_s", "fish_bicgstab_bytes_compiler",
-                     "warm_start_s"):
+                     "warm_start_s", "recover_restart_s"):
             assert by[name]["regressed"], (name, by[name])
         # a malformed line is skipped, not fatal
         with open(store.path, "a") as f:
